@@ -1,0 +1,19 @@
+"""makisu-tpu: a TPU-native, daemonless, unprivileged container-image builder.
+
+A from-scratch re-design of the capability surface of uber/makisu
+(reference: /root/reference, pure Go) built TPU-first:
+
+- The builder plane (Dockerfile parsing, snapshotting, registry v2,
+  distributed cache) is Python + native C++ where hot.
+- The layer-commit hot path (reference: lib/builder/step/common.go:35-67)
+  flows every layer byte through a narrow ``chunker.Hasher`` seam whose TPU
+  implementation runs Gear content-defined chunking and SHA-256 as
+  data-parallel JAX programs sharded over a ``jax.sharding.Mesh``.
+- Chunk fingerprints flow into the distributed cache for chunk-granular
+  dedup (the reference dedups whole layers only:
+  lib/cache/cache_manager.go:39-40).
+"""
+
+__version__ = "0.1.0"
+
+BUILD_HASH = "dev"

@@ -1,0 +1,39 @@
+"""makisu-tpu command line: build / pull / push / diff / version.
+
+Reference surface: bin/makisu/cmd/ (root.go:73-87). Subcommands are filled
+in as their subsystems land; ``version`` is always available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import makisu_tpu
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="makisu-tpu",
+        description="TPU-native daemonless container image builder.")
+    parser.add_argument("--log-level", default="info",
+                        choices=["debug", "info", "warn", "error"])
+    parser.add_argument("--log-fmt", default="json",
+                        choices=["json", "console"])
+    sub = parser.add_subparsers(dest="command")
+    sub.add_parser("version", help="print the build version")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        print(makisu_tpu.BUILD_HASH)
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Docker image data model and daemon client."""

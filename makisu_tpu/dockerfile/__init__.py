@@ -1,0 +1,45 @@
+"""Dockerfile frontend: parse Dockerfile text into stages of directives.
+
+Pure (no I/O, no deps on the rest of the framework); reference surface:
+lib/parser/dockerfile/ (ParseFile at parse_file.go:24).
+"""
+
+from makisu_tpu.dockerfile.directives import (
+    AddDirective,
+    ArgDirective,
+    CmdDirective,
+    CopyDirective,
+    Directive,
+    EntrypointDirective,
+    EnvDirective,
+    ExposeDirective,
+    FromDirective,
+    HealthcheckDirective,
+    LabelDirective,
+    MaintainerDirective,
+    ParseError,
+    RunDirective,
+    StopsignalDirective,
+    UserDirective,
+    VolumeDirective,
+    WorkdirDirective,
+    parse_duration,
+)
+from makisu_tpu.dockerfile.parse import ParsingState, Stage, parse_file
+from makisu_tpu.dockerfile.text import (
+    TextParseError,
+    parse_key_vals,
+    replace_variables,
+    split_args,
+)
+
+__all__ = [
+    "AddDirective", "ArgDirective", "CmdDirective", "CopyDirective",
+    "Directive", "EntrypointDirective", "EnvDirective", "ExposeDirective",
+    "FromDirective", "HealthcheckDirective", "LabelDirective",
+    "MaintainerDirective", "ParseError", "RunDirective",
+    "StopsignalDirective", "UserDirective", "VolumeDirective",
+    "WorkdirDirective", "ParsingState", "Stage", "TextParseError",
+    "parse_duration", "parse_file", "parse_key_vals", "replace_variables",
+    "split_args",
+]

@@ -1,0 +1,109 @@
+"""File-level Dockerfile parsing: stages, scoping state, #!COMMIT.
+
+Reference behavior being matched (lib/parser/dockerfile/parse_file.go,
+state.go, base.go): comment lines and blank lines are removed, ``\\``-newline
+continuations are joined, then each line becomes one directive. Variable
+scoping has three layers — build args passed in by the caller, global ARGs
+(declared before the first FROM, visible to FROM lines), and per-stage vars
+(reset at each FROM, fed by ARG and ENV).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from makisu_tpu.dockerfile.directives import (
+    DIRECTIVES,
+    Directive,
+    FromDirective,
+    ParseError,
+)
+from makisu_tpu.dockerfile.text import strip_inline_comment
+
+_COMMIT_RE = re.compile(r"\s*#!\s*commit\s*", re.I)
+
+
+@dataclasses.dataclass
+class Stage:
+    """One build stage: a FROM directive plus its body."""
+
+    from_directive: FromDirective
+    directives: list[Directive] = dataclasses.field(default_factory=list)
+
+    @property
+    def alias(self) -> str:
+        return self.from_directive.alias
+
+
+class ParsingState:
+    """Variable scopes threaded through directive parsing."""
+
+    def __init__(self, passed_args: dict[str, str] | None) -> None:
+        self.stages: list[Stage] = []
+        self.passed_args: dict[str, str] = dict(passed_args or {})
+        self.global_args: dict[str, str] = {}
+        self.stage_vars: dict[str, str] | None = None  # None until first FROM
+
+    def current_or_global_vars(self) -> dict[str, str]:
+        return self.stage_vars if self.stage_vars is not None else self.global_args
+
+    def require_stage_vars(self, directive: str) -> dict[str, str]:
+        if self.stage_vars is None:
+            raise ParseError(directive, "",
+                             "invalid before the first build stage (FROM)")
+        return self.stage_vars
+
+    def new_stage(self, from_directive: FromDirective) -> None:
+        self.stages.append(Stage(from_directive))
+        self.stage_vars = {}
+
+    def add_to_current_stage(self, d: Directive) -> None:
+        if not self.stages:
+            raise ParseError(type(d).__name__, d.args,
+                             "invalid before the first build stage (FROM)")
+        self.stages[-1].directives.append(d)
+
+
+def parse_line(line: str, state: ParsingState) -> Directive | None:
+    """Parse one logical line into a directive, or None for empty lines."""
+    commit = False
+    hash_idx = line.find("#")
+    if hash_idx != -1:
+        commit = bool(_COMMIT_RE.search(line[hash_idx:].lower()))
+        line = strip_inline_comment(line)
+    stripped = line.strip()
+    if not stripped:
+        return None
+    parts = stripped.split(None, 1)
+    if len(parts) != 2:
+        raise ValueError(f"failed to parse directive line: {line!r}")
+    name, args = parts[0].lower(), parts[1].strip()
+    cls = DIRECTIVES.get(name)
+    if cls is None:
+        raise ValueError(f"unsupported directive type: {parts[0]!r}")
+    return cls.parse(args, commit, state)
+
+
+def parse_file(contents: str, build_args: dict[str, str] | None = None,
+               ) -> list[Stage]:
+    """Parse Dockerfile text into stages.
+
+    ``build_args`` are the caller's ``--build-arg`` values, consulted when
+    ARG directives declare matching names.
+    """
+    # Full-line comments go first so a trailing "\" on a comment line does
+    # not join it with the next line; then continuations are spliced.
+    kept = [l for l in contents.split("\n") if l.strip(" \t")
+            and l.strip(" \t")[0] != "#"]
+    spliced = "\n".join(kept).replace("\\\n", "")
+
+    state = ParsingState(build_args)
+    for lineno, line in enumerate(spliced.split("\n"), start=1):
+        try:
+            directive = parse_line(line, state)
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: {e}") from e
+        if directive is not None:
+            directive.update(state)
+    return state.stages

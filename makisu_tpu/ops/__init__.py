@@ -1,0 +1,16 @@
+"""TPU compute kernels for the layer-commit hot path.
+
+The reference's hot loop (lib/builder/step/common.go:35-67) streams layer-tar
+bytes through two sequential SHA-256 digesters on CPU. Here the equivalent
+work is re-designed data-parallel for the TPU VPU:
+
+- ``sha256``: SHA-256 over many independent lanes (chunks) at once. Each
+  uint32 op in the compression function is an elementwise op over a lane
+  vector, so 1024+ messages hash in lock-step on the 8x128 VPU.
+- ``gear``: Gear rolling-hash content-defined chunking. The sequential
+  recurrence ``h_i = (h_{i-1} << 1) + G[b_i] (mod 2^32)`` is exactly a
+  32-byte windowed correlation (terms older than 32 bytes shift out mod
+  2^32), computed in 5 log-doubling steps — fully parallel over positions.
+"""
+
+from makisu_tpu.ops import gear, sha256  # noqa: F401

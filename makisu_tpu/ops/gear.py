@@ -1,0 +1,171 @@
+"""Gear content-defined chunking as a data-parallel TPU program.
+
+Gear CDC walks a byte stream with the recurrence
+
+    h_i = (h_{i-1} << 1) + G[b_i]   (mod 2^32)
+
+and cuts a chunk boundary after byte i when ``h_i & mask == 0``. The
+recurrence looks inherently sequential, but mod 2^32 the contribution of a
+byte k positions back is ``G[b_{i-k}] << k``, which vanishes for k >= 32.
+So the sequential hash *equals* a 32-byte windowed correlation:
+
+    h_i = sum_{k=0}^{31} G[b_{i-k}] << k   (mod 2^32)
+
+which this module computes for every position at once in 5 log-doubling
+steps (window 1 -> 2 -> 4 -> 8 -> 16 -> 32):
+
+    H_1[i]    = G[b_i]
+    H_2m[i]   = H_m[i] + (H_m[i-m] << m)
+
+Each step is one shifted slice, one constant bit-shift, one add over the
+whole buffer — pure VPU elementwise work, ~15 int ops/byte, fully
+parallel over positions and over a batch axis, and shardable along the
+sequence axis with a 31-byte halo (see parallel/pipeline.py).
+
+This is the project's "ring-attention equivalent" (SURVEY.md §5): it makes
+the long-stream dimension parallelizable so per-chunk SHA-256 lanes
+(ops/sha256.py) can do the heavy hashing in parallel. The reference has no
+counterpart — it hashes layers as single sequential streams
+(lib/builder/step/common.go:35-67) and caches whole layers only.
+
+Boundary decisions come back to the host as a bit-packed bitmap (32 bytes of
+input per output uint32 word = 3% readback); min/max chunk-size policy is a
+cheap greedy pass over candidate positions on the host (makisu_tpu/chunker).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WINDOW = 32  # bytes of history that survive mod 2^32
+
+# Default chunking geometry: 8 KiB average (mask of 13 bits), 2 KiB min,
+# 64 KiB max. Matches common CDC deployments (FastCDC, restic are 512B-8MB
+# range; container layers skew to many small text files).
+DEFAULT_AVG_BITS = 13
+DEFAULT_MIN_SIZE = 2 * 1024
+DEFAULT_MAX_SIZE = 64 * 1024
+
+
+def _splitmix32(x: int) -> int:
+    x = (x + 0x9E3779B9) & 0xFFFFFFFF
+    z = x
+    z = ((z ^ (z >> 16)) * 0x21F0AAAD) & 0xFFFFFFFF
+    z = ((z ^ (z >> 15)) * 0x735A2D97) & 0xFFFFFFFF
+    return (z ^ (z >> 15)) & 0xFFFFFFFF
+
+
+@functools.lru_cache(maxsize=1)
+def gear_table() -> np.ndarray:
+    """Deterministic 256-entry uint32 gear table (stable across versions —
+    cache keys derived from it must never change)."""
+    state = 0x6D616B69  # "maki"
+    vals = []
+    for _ in range(256):
+        vals.append(_splitmix32(state))
+        state = (state + 0x9E3779B9) & 0xFFFFFFFF
+    return np.array(vals, dtype=np.uint32)
+
+
+def _shift_seq(h: jax.Array, m: int) -> jax.Array:
+    """h[..., i-m] with zero fill at the left edge (static shift)."""
+    pad = [(0, 0)] * (h.ndim - 1) + [(m, 0)]
+    return jnp.pad(h, pad)[..., :-m]
+
+
+def gear_hash(data: jax.Array) -> jax.Array:
+    """Per-position Gear hashes for uint8 data [..., N].
+
+    Position i's hash covers bytes max(0, i-31)..i, i.e. the stream is
+    treated as starting at index 0 (zero history). For segmented streams
+    pass 31 bytes of left halo and drop the first 31 outputs.
+    """
+    table = jnp.asarray(gear_table())
+    g = table[data.astype(jnp.int32)]
+    h = g
+    m = 1
+    while m < WINDOW:
+        h = h + (_shift_seq(h, m) << jnp.uint32(m))
+        m *= 2
+    return h
+
+
+def boundary_mask(h: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
+    """Candidate-boundary bool mask from per-position hashes."""
+    mask = jnp.uint32((1 << avg_bits) - 1)
+    return (h & mask) == 0
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """bool [..., N] -> uint32 [..., N//32] little-bit-order bitmap."""
+    n = bits.shape[-1]
+    if n % 32:
+        raise ValueError(f"bit count {n} not a multiple of 32")
+    b = bits.reshape(*bits.shape[:-1], n // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits_np(words: np.ndarray, n: int) -> np.ndarray:
+    """uint32 [..., W] bitmap -> bool [..., n] (host side, numpy)."""
+    le_bytes = np.asarray(words, dtype="<u4").view(np.uint8)
+    bits = np.unpackbits(le_bytes.reshape(*words.shape[:-1], -1),
+                         axis=-1, bitorder="little")
+    return bits[..., :n].astype(bool)
+
+
+@jax.jit
+def gear_bitmap(data: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
+    """Fused: uint8 [..., N] -> packed candidate bitmap uint32 [..., N//32]."""
+    return pack_bits(boundary_mask(gear_hash(data), avg_bits))
+
+
+def select_boundaries_np(
+    candidates: np.ndarray,
+    n: int,
+    min_size: int = DEFAULT_MIN_SIZE,
+    max_size: int = DEFAULT_MAX_SIZE,
+) -> np.ndarray:
+    """Greedy min/max chunk policy over candidate cut positions (host side).
+
+    candidates: sorted int array of positions p meaning "cut after byte p"
+    n:          stream length
+    Returns cut *end offsets* (exclusive), always ending with n.
+
+    Deterministic for identical byte content, which is all the chunk-dedup
+    cache needs. Oversize gaps are split at fixed strides from the previous
+    (content-defined) cut, so splits are content-anchored too.
+    """
+    cuts = []
+    prev = 0
+    for p in np.asarray(candidates, dtype=np.int64):
+        end = int(p) + 1
+        if end - prev < min_size:
+            continue
+        while end - prev > max_size:
+            prev += max_size
+            cuts.append(prev)
+        if end - prev >= min_size:
+            cuts.append(end)
+            prev = end
+    while n - prev > max_size:
+        prev += max_size
+        cuts.append(prev)
+    if prev < n or n == 0:
+        cuts.append(n)
+    return np.array(cuts, dtype=np.int64)
+
+
+def gear_hash_ref(data: bytes) -> np.ndarray:
+    """Pure-Python sequential reference (for tests): h_i for every i."""
+    table = gear_table()
+    out = np.empty(len(data), dtype=np.uint32)
+    h = 0
+    for i, byte in enumerate(data):
+        h = ((h << 1) + int(table[byte])) & 0xFFFFFFFF
+        out[i] = h
+    return out

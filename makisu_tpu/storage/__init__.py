@@ -1,0 +1,17 @@
+"""Local image storage: content-addressed layer store, manifest store,
+per-build sandbox.
+
+Capability parity with the reference's lib/storage/ (ImageStore at
+image_store.go:28-61, LayerTarStore layer_tar_store.go:35-137, ManifestStore
+manifest_store.go:39-99, generic state-machine store under storage/base/).
+The design here is a fresh, simpler one: a thread-safe CAS with atomic
+tmp+rename commits and last-access LRU eviction replaces the reference's
+FileState/FileOp machinery while keeping the same observable operations
+(download → commit transition, hardlink in/out, LRU caps).
+"""
+
+from makisu_tpu.storage.cas import CASStore
+from makisu_tpu.storage.image_store import ImageStore
+from makisu_tpu.storage.manifests import ManifestStore
+
+__all__ = ["CASStore", "ImageStore", "ManifestStore"]

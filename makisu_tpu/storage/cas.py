@@ -1,0 +1,162 @@
+"""Thread-safe content-addressed store with LRU eviction.
+
+Reference capabilities covered: lib/storage/layer_tar_store.go (CAS by hex
+digest, download→cache state transition, hardlink in/out, LRU 256) and the
+generic machinery under lib/storage/base/ (atomic state transitions,
+last-access tracking, sharded dirs). Implementation is original: one class,
+per-key locks via a single mutex + atomic os.rename commits, eviction by
+persisted last-access time.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import BinaryIO, Callable, Iterator
+
+_SHARD_CHARS = 2
+
+
+class CASStore:
+    """Content-addressed files under ``root/<aa>/<name>``.
+
+    Names are arbitrary keys (layer hex digests in practice). Files land via
+    ``write_file``/``link_file``/a download handle, always committed with an
+    atomic rename so readers never observe partial content. ``max_entries``
+    bounds the store; least-recently-used entries are evicted on overflow.
+    """
+
+    def __init__(self, root: str, max_entries: int = 256) -> None:
+        self.root = root
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._last_access: dict[str, float] = {}
+        os.makedirs(root, exist_ok=True)
+        self._tmp_dir = os.path.join(root, "_tmp")
+        os.makedirs(self._tmp_dir, exist_ok=True)
+        for name in self.keys():
+            self._last_access[name] = os.path.getmtime(self._path(name))
+
+    def _path(self, name: str) -> str:
+        shard = name[:_SHARD_CHARS] if len(name) > _SHARD_CHARS else "__"
+        return os.path.join(self.root, shard, name)
+
+    def _touch(self, name: str) -> None:
+        self._last_access[name] = time.time()
+
+    # -- queries ----------------------------------------------------------
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            if os.path.isfile(self._path(name)):
+                self._touch(name)
+                return True
+            return False
+
+    def size(self, name: str) -> int:
+        with self._lock:
+            size = os.path.getsize(self._path(name))  # raises if absent
+            self._touch(name)
+            return size
+
+    def keys(self) -> list[str]:
+        out = []
+        for shard in os.listdir(self.root):
+            sharddir = os.path.join(self.root, shard)
+            if shard == "_tmp" or not os.path.isdir(sharddir):
+                continue
+            out.extend(os.listdir(sharddir))
+        return out
+
+    # -- ingest -----------------------------------------------------------
+
+    def write_file(self, name: str, write: Callable[[BinaryIO], None]) -> str:
+        """Stream content into the store via ``write(fileobj)``; atomic."""
+        fd, tmp = tempfile.mkstemp(dir=self._tmp_dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                write(f)
+            return self._commit(name, tmp)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def write_bytes(self, name: str, data: bytes) -> str:
+        return self.write_file(name, lambda f: f.write(data))
+
+    def link_file(self, name: str, src: str) -> str:
+        """Ingest an existing file by hardlink (falls back to copy across
+        filesystems)."""
+        # A private subdir keeps the link target unique: os.link refuses to
+        # overwrite, so the name must not be reusable by a concurrent
+        # mkstemp the way an unlinked mkstemp path would be.
+        tmp_parent = tempfile.mkdtemp(dir=self._tmp_dir)
+        tmp = os.path.join(tmp_parent, "link")
+        try:
+            try:
+                os.link(src, tmp)
+            except OSError:
+                shutil.copy2(src, tmp)
+            return self._commit(name, tmp)
+        finally:
+            shutil.rmtree(tmp_parent, ignore_errors=True)
+
+    def _commit(self, name: str, tmp: str) -> str:
+        dst = self._path(name)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        with self._lock:
+            if os.path.isfile(dst):
+                self._touch(name)  # first writer wins; content is identical
+                return dst
+            os.rename(tmp, dst)
+            self._touch(name)
+            self._evict_locked()
+        return dst
+
+    # -- egress -----------------------------------------------------------
+
+    def path(self, name: str) -> str:
+        """Path of a stored file (raises FileNotFoundError if absent)."""
+        p = self._path(name)
+        with self._lock:
+            if not os.path.isfile(p):
+                raise FileNotFoundError(f"{name} not in store {self.root}")
+            self._touch(name)
+        return p
+
+    def open(self, name: str) -> BinaryIO:
+        return open(self.path(name), "rb")
+
+    def link_out(self, name: str, dst: str) -> None:
+        """Hardlink a stored file out to ``dst`` (copy across filesystems)."""
+        src = self.path(name)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.exists(dst):
+            os.unlink(dst)
+        try:
+            os.link(src, dst)
+        except OSError:
+            shutil.copy2(src, dst)
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            p = self._path(name)
+            if os.path.isfile(p):
+                os.unlink(p)
+            self._last_access.pop(name, None)
+
+    # -- eviction ---------------------------------------------------------
+
+    def _evict_locked(self) -> None:
+        while len(self._last_access) > self.max_entries:
+            victim = min(self._last_access, key=self._last_access.get)
+            p = self._path(victim)
+            if os.path.isfile(p):
+                os.unlink(p)
+            del self._last_access[victim]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())

@@ -1,0 +1,64 @@
+"""Manifest store: repo:tag → distribution manifest JSON on disk.
+
+Reference capability: lib/storage/manifest_store.go:39-99 (LRU 16). Keys are
+``<repo>/<tag>`` with path separators in the repo preserved as directories.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from makisu_tpu.docker.image import DistributionManifest, ImageName
+
+
+class ManifestStore:
+    def __init__(self, root: str, max_entries: int = 16) -> None:
+        self.root = root
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: ImageName) -> str:
+        tag = name.tag.replace(":", "_")
+        return os.path.join(self.root, name.repository, tag + ".json")
+
+    def save(self, name: ImageName, manifest: DistributionManifest) -> str:
+        p = self._path(name)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(manifest.to_json(), f)
+            os.rename(tmp, p)
+            self._evict_locked()
+        return p
+
+    def load(self, name: ImageName) -> DistributionManifest:
+        with open(self._path(name)) as f:
+            return DistributionManifest.from_json(json.load(f))
+
+    def exists(self, name: ImageName) -> bool:
+        return os.path.isfile(self._path(name))
+
+    def delete(self, name: ImageName) -> None:
+        p = self._path(name)
+        if os.path.isfile(p):
+            os.unlink(p)
+
+    def _evict_locked(self) -> None:
+        entries: list[tuple[float, str]] = []
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                if fn.endswith(".json"):
+                    p = os.path.join(dirpath, fn)
+                    entries.append((os.path.getmtime(p), p))
+        entries.sort()
+        while len(entries) > self.max_entries:
+            _, victim = entries.pop(0)
+            os.unlink(victim)
+
+    def touch(self, name: ImageName) -> None:
+        os.utime(self._path(name), (time.time(), time.time()))

@@ -1,0 +1,1 @@
+"""Shared utilities: paths, logging, io, mounts, http."""

@@ -1,0 +1,85 @@
+"""Structured logging: JSON (default, k8s-friendly) or console encoding.
+
+Reference: lib/log (zap singleton) + bin/makisu/cmd/common.go:46-66.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+_LOGGER_NAME = "makisu"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict[str, Any] = {
+            "level": record.levelname.lower(),
+            "ts": round(time.time(), 6),
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "fields", None)
+        if extra:
+            out.update(extra)
+        if record.exc_info and record.exc_info[0]:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class _ConsoleFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        msg = f"{ts} {record.levelname:<5} {record.getMessage()}"
+        extra = getattr(record, "fields", None)
+        if extra:
+            kv = " ".join(f"{k}={v}" for k, v in extra.items())
+            msg = f"{msg}  {kv}"
+        if record.exc_info and record.exc_info[0]:
+            msg += "\n" + self.formatException(record.exc_info)
+        return msg
+
+
+def configure(level: str = "info", fmt: str = "json",
+              output: str = "stdout") -> None:
+    logger = logging.getLogger(_LOGGER_NAME)
+    logger.handlers.clear()
+    stream = sys.stderr if output == "stderr" else sys.stdout
+    handler = (logging.FileHandler(output) if output not in
+               ("stdout", "stderr") else logging.StreamHandler(stream))
+    handler.setFormatter(_JsonFormatter() if fmt == "json"
+                         else _ConsoleFormatter())
+    logger.addHandler(handler)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    logger.propagate = False
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        configure(fmt="console")
+    return logger
+
+
+def _log(level: int, msg: str, *args: Any, **fields: Any) -> None:
+    if args:
+        msg = msg % args
+    get_logger().log(level, msg, extra={"fields": fields} if fields else {})
+
+
+def debug(msg: str, *args: Any, **fields: Any) -> None:
+    _log(logging.DEBUG, msg, *args, **fields)
+
+
+def info(msg: str, *args: Any, **fields: Any) -> None:
+    _log(logging.INFO, msg, *args, **fields)
+
+
+def warning(msg: str, *args: Any, **fields: Any) -> None:
+    _log(logging.WARNING, msg, *args, **fields)
+
+
+def error(msg: str, *args: Any, **fields: Any) -> None:
+    _log(logging.ERROR, msg, *args, **fields)

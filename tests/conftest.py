@@ -1,0 +1,27 @@
+"""Test harness: run all JAX work on a virtual 8-device CPU mesh.
+
+Mirrors the reference's hermetic test strategy (SURVEY.md §4): no real
+registry, no real TPU needed. Env vars must be set before jax imports.
+"""
+
+import os
+
+# The ambient environment pins JAX_PLATFORMS=axon (the real TPU tunnel) and
+# sitecustomize imports jax at interpreter startup, so jax has already
+# snapshotted that env var — os.environ edits are too late. XLA_FLAGS is
+# still unread (backends are uninitialized), so set it first, then override
+# the platform through jax.config.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Reuse compiled executables across test processes.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")

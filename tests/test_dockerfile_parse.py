@@ -1,0 +1,237 @@
+"""Directive- and file-level Dockerfile parser tests.
+
+Mirrors the behavior classes of the reference's per-directive tests and the
+multistage fixture test (lib/parser/dockerfile/*_test.go, fixtures_test.go).
+"""
+
+import pytest
+
+from makisu_tpu.dockerfile import (
+    AddDirective,
+    ArgDirective,
+    CmdDirective,
+    CopyDirective,
+    EntrypointDirective,
+    EnvDirective,
+    ExposeDirective,
+    FromDirective,
+    HealthcheckDirective,
+    LabelDirective,
+    MaintainerDirective,
+    RunDirective,
+    StopsignalDirective,
+    UserDirective,
+    VolumeDirective,
+    WorkdirDirective,
+    parse_file,
+)
+
+
+def parse1(text, args=None):
+    stages = parse_file(text, args)
+    assert len(stages) == 1
+    return stages[0]
+
+
+def test_from_plain():
+    stage = parse1("FROM alpine:3.9")
+    assert stage.from_directive.image == "alpine:3.9"
+    assert stage.alias == ""
+
+
+def test_from_alias_and_case():
+    stage = parse1("from alpine AS builder")
+    assert stage.from_directive.image == "alpine"
+    assert stage.alias == "builder"
+
+
+def test_from_bad_alias():
+    with pytest.raises(ValueError):
+        parse_file("FROM alpine AS")
+    with pytest.raises(ValueError):
+        parse_file("FROM alpine WITH alias")
+
+
+def test_from_uses_global_args():
+    stage = parse1("ARG TAG=3.9\nFROM alpine:$TAG")
+    assert stage.from_directive.image == "alpine:3.9"
+
+
+def test_from_global_arg_passed_value():
+    stage = parse1("ARG TAG=3.9\nFROM alpine:${TAG}", {"TAG": "edge"})
+    assert stage.from_directive.image == "alpine:edge"
+
+
+def test_directive_before_from_fails():
+    with pytest.raises(ValueError):
+        parse_file("RUN echo hi")
+
+
+def test_run_shell_and_json():
+    stage = parse1('FROM a\nRUN echo hi\nRUN ["ls", "-la"]')
+    r1, r2 = stage.directives
+    assert isinstance(r1, RunDirective) and r1.cmd == "echo hi"
+    assert r2.cmd == "ls -la"
+
+
+def test_run_commit_annotation():
+    stage = parse1("FROM a\nRUN make #!COMMIT\nRUN ls")
+    assert stage.directives[0].commit is True
+    assert stage.directives[1].commit is False
+
+
+def test_cmd_forms():
+    stage = parse1('FROM a\nCMD ["a", "b"]\nCMD echo && ls')
+    c1, c2 = stage.directives
+    assert isinstance(c1, CmdDirective) and c1.cmd == ["a", "b"]
+    assert c2.cmd == ["/bin/sh", "-c", "echo && ls"]
+
+
+def test_entrypoint_forms():
+    stage = parse1('FROM a\nENTRYPOINT ["/bin/app"]\nENTRYPOINT run me')
+    e1, e2 = stage.directives
+    assert isinstance(e1, EntrypointDirective) and e1.entrypoint == ["/bin/app"]
+    assert e2.entrypoint == ["/bin/sh", "-c", "run me"]
+
+
+def test_env_forms_and_substitution():
+    stage = parse1(
+        "FROM a\nENV A=1 B=two\nENV legacy some value here\nENV C=$A")
+    e1, e2, e3 = stage.directives
+    assert isinstance(e1, EnvDirective) and e1.envs == {"A": "1", "B": "two"}
+    assert e2.envs == {"legacy": "some value here"}
+    assert e3.envs == {"C": "1"}
+
+
+def test_arg_with_default_and_passed():
+    stage = parse1("FROM a\nARG X=def\nARG Y", {"Y": "passed"})
+    a1, a2 = stage.directives
+    assert isinstance(a1, ArgDirective)
+    assert a1.resolved_val == "def"
+    assert a2.resolved_val == "passed"
+
+
+def test_arg_feeds_later_directives():
+    stage = parse1("FROM a\nARG X=v1\nENV OUT=$X")
+    assert stage.directives[1].envs == {"OUT": "v1"}
+
+
+def test_global_arg_fills_stage_arg():
+    # Global ARG value reaches a stage that redeclares the ARG bare.
+    stage = parse1("ARG G=gv\nFROM a\nARG G\nENV OUT=$G")
+    assert stage.directives[-1].envs == {"OUT": "gv"}
+
+
+def test_stage_vars_reset_between_stages():
+    stages = parse_file("FROM a\nENV X=1\nFROM b\nENV Y=$X")
+    assert stages[1].directives[0].envs == {"Y": "$X"}
+
+
+def test_label_and_maintainer():
+    stage = parse1('FROM a\nLABEL k="v 1" z=2\nMAINTAINER Jane <j@x.io>')
+    l, m = stage.directives
+    assert isinstance(l, LabelDirective) and l.labels == {"k": "v 1", "z": "2"}
+    assert isinstance(m, MaintainerDirective) and m.author == "Jane <j@x.io>"
+
+
+def test_expose_volume_user_workdir_stopsignal():
+    stage = parse1(
+        "FROM a\nEXPOSE 80 443/tcp\nVOLUME /data /logs\n"
+        'VOLUME ["/json way"]\nUSER app\nWORKDIR /srv\nSTOPSIGNAL 15')
+    ex, v1, v2, u, w, s = stage.directives
+    assert isinstance(ex, ExposeDirective) and ex.ports == ["80", "443/tcp"]
+    assert isinstance(v1, VolumeDirective) and v1.volumes == ["/data", "/logs"]
+    assert v2.volumes == ["/json way"]
+    assert isinstance(u, UserDirective) and u.user == "app"
+    assert isinstance(w, WorkdirDirective) and w.working_dir == "/srv"
+    assert isinstance(s, StopsignalDirective) and s.signal == 15
+
+
+def test_stopsignal_invalid():
+    with pytest.raises(ValueError):
+        parse_file("FROM a\nSTOPSIGNAL SIGTERM")
+
+
+def test_copy_basic_and_flags():
+    stage = parse1(
+        "FROM a\nCOPY src dst\nCOPY --from=builder /out /in\n"
+        "COPY --chown=1:2 a b c/\nCOPY --archive x y\n"
+        'COPY ["has space", "dst dir"]')
+    c1, c2, c3, c4, c5 = stage.directives
+    assert isinstance(c1, CopyDirective)
+    assert (c1.srcs, c1.dst) == (["src"], "dst")
+    assert c2.from_stage == "builder"
+    assert c3.chown == "1:2" and c3.srcs == ["a", "b"] and c3.dst == "c/"
+    assert c4.preserve_owner is True
+    assert c5.srcs == ["has space"] and c5.dst == "dst dir"
+
+
+def test_copy_two_flags_rejected():
+    with pytest.raises(ValueError):
+        parse_file("FROM a\nCOPY --chown=1 --archive a b")
+
+
+def test_copy_missing_dst():
+    with pytest.raises(ValueError):
+        parse_file("FROM a\nCOPY onlyone")
+
+
+def test_add_flags():
+    stage = parse1("FROM a\nADD --chown=app:app tar.tgz /opt/")
+    a = stage.directives[0]
+    assert isinstance(a, AddDirective)
+    assert a.chown == "app:app" and a.srcs == ["tar.tgz"] and a.dst == "/opt/"
+
+
+def test_healthcheck_none():
+    stage = parse1("FROM a\nHEALTHCHECK NONE")
+    h = stage.directives[0]
+    assert isinstance(h, HealthcheckDirective) and h.test == ["NONE"]
+
+
+def test_healthcheck_cmd_shell():
+    stage = parse1(
+        "FROM a\n"
+        "HEALTHCHECK --interval=5m --timeout=3s --retries=2 "
+        "CMD curl -f http://localhost/")
+    h = stage.directives[0]
+    assert h.interval == 5 * 60 * 10**9
+    assert h.timeout == 3 * 10**9
+    assert h.retries == 2
+    assert h.test == ["CMD-SHELL", "curl -f http://localhost/"]
+
+
+def test_healthcheck_cmd_json():
+    stage = parse1('FROM a\nHEALTHCHECK CMD ["curl", "-f", "x"]')
+    assert stage.directives[0].test == ["CMD", "curl", "-f", "x"]
+
+
+def test_comments_and_continuations():
+    stage = parse1(
+        "# leading comment\n"
+        "FROM a\n"
+        "RUN echo one && \\\n    echo two\n"
+        "   # indented comment\n"
+        "RUN echo 'sharp # inside quotes' # trailing comment\n")
+    r1, r2 = stage.directives
+    assert r1.cmd == "echo one &&     echo two"
+    assert r2.cmd == "echo 'sharp # inside quotes'"
+
+
+def test_unknown_directive():
+    with pytest.raises(ValueError):
+        parse_file("FROM a\nBOGUS xyz")
+
+
+def test_multistage_copy_from_chain():
+    stages = parse_file(
+        "ARG BASE=alpine\n"
+        "FROM $BASE AS build\n"
+        "RUN make\n"
+        "FROM scratch\n"
+        "COPY --from=build /bin/app /app\n"
+        'ENTRYPOINT ["/app"]\n')
+    assert [s.alias for s in stages] == ["build", ""]
+    assert stages[0].from_directive.image == "alpine"
+    copy = stages[1].directives[0]
+    assert isinstance(copy, CopyDirective) and copy.from_stage == "build"

@@ -1,0 +1,125 @@
+"""Table-driven tests for the Dockerfile text micro-grammars.
+
+Behavior classes mirrored from the reference suite
+(lib/parser/dockerfile/{replace_variables,split_args,parse_key_values}_test.go);
+cases are our own.
+"""
+
+import pytest
+
+from makisu_tpu.dockerfile import (
+    TextParseError,
+    parse_key_vals,
+    replace_variables,
+    split_args,
+)
+
+M = {"key": "VAL", "VAL": "VAL2", "test_VAL": "VAL3",
+     "VAL_test": "VAL4", "VAL2": "VAL5"}
+
+
+@pytest.mark.parametrize("inp,vars,want", [
+    ("text$key", M, "textVAL"),
+    ("$key$key", M, "VALVAL"),
+    ("text${key}", M, "textVAL"),
+    ('text"$key"', M, 'text"VAL"'),
+    ("text${$key}", M, "textVAL2"),            # nested simple
+    ("text${${key}}", M, "textVAL2"),          # nested braced
+    ("text${test_$key}", M, "textVAL3"),       # prefix + nested
+    ("text${${key}_test}", M, "textVAL4"),     # nested + suffix
+    ("text$", {}, "text$"),
+    ("text${}", {}, "text${}"),
+    ("text$key", {}, "text$key"),              # unset stays literal
+    ("text${key}", {}, "text${key}"),
+    ("text${$VAL2}", M, "text${VAL5}"),        # nested resolves, outer unset
+    ("$key text", M, "VAL text"),
+    ("${key}text", M, "VALtext"),
+    ("text ${key:-default} text", M, "text VAL text"),
+    ("text ${key:-default} text", {}, "text default text"),
+    ("text ${key:+alt} text", M, "text alt text"),
+    ("text ${key:+alt} text", {}, "text  text"),
+    ("text ${$VAL:-default} text", M, "text VAL5 text"),
+    ("text ${${key}:-default} text", M, "text VAL2 text"),
+    (r"text ${key:-\\} text", {}, r"text \\ text"),
+    (r"text ${key:-\}} text", {}, "text } text"),
+    (r"pre \$key post", M, "pre $key post"),   # escaped dollar
+    ("pre \\key", M, "pre \\key"),             # other backslash kept
+    ("$key-suffix", M, "$key-suffix"),         # '-' is a key char
+    ("$key/suffix", M, "VAL/suffix"),          # '/' ends the name
+])
+def test_replace_variables(inp, vars, want):
+    assert replace_variables(inp, vars) == want
+
+
+@pytest.mark.parametrize("inp", [
+    "text${",
+    "text${key",
+    "text ${key:",
+    "text ${:",
+    "text ${key:z}",      # bad default command
+    "text ${key:-}",      # empty default
+    "text ${key:+}",      # empty alternate
+])
+def test_replace_variables_errors(inp):
+    with pytest.raises(TextParseError):
+        replace_variables(inp, M)
+
+
+@pytest.mark.parametrize("inp,for_shell,want", [
+    ("a b  c", False, ["a", "b", "c"]),
+    ('a "b c" d', False, ["a", "b c", "d"]),
+    ('"a b"', False, ["a b"]),
+    ('""', False, [""]),
+    (r'a\ b c', False, ["a b", "c"]),
+    (r'a \"quoted\"', False, ['a', '"quoted"']),
+    ("", False, []),
+    ("  ", False, []),
+    ('echo "hi there"', True, ["echo", '"hi there"']),  # shell keeps quotes
+    ("a && b", True, ["a", "&&", "b"]),
+    ("a&&b", True, ["a", "&&", "b"]),
+    ("a | b ; c", True, ["a", "|", "b", ";", "c"]),
+    ('echo "a;b"', True, ["echo", '"a;b"']),   # ops inside quotes are literal
+])
+def test_split_args(inp, for_shell, want):
+    assert split_args(inp, for_shell) == want
+
+
+@pytest.mark.parametrize("inp", [
+    '"unterminated',
+    'a "b" c"',   # quote immediately after token end is fine; this one opens
+])
+def test_split_args_errors(inp):
+    with pytest.raises(TextParseError):
+        split_args(inp)
+
+
+def test_split_args_missing_space_after_quote():
+    with pytest.raises(TextParseError):
+        split_args('"ab"cd')
+
+
+@pytest.mark.parametrize("inp,want", [
+    ("k=v", {"k": "v"}),
+    ("k=v a=b", {"k": "v", "a": "b"}),
+    ('k="v with spaces" x=1', {"k": "v with spaces", "x": "1"}),
+    ('msg=""', {"msg": ""}),                     # quoted empty value ok
+    (r"k=a\ b", {"k": "a b"}),
+    ('k="quote\\"in"', {"k": 'quote"in'}),
+    ("", {}),
+    ("a.b-c_d=1", {"a.b-c_d": "1"}),
+])
+def test_parse_key_vals(inp, want):
+    assert parse_key_vals(inp) == want
+
+
+@pytest.mark.parametrize("inp", [
+    "novalue",       # missing '='
+    "k=",            # missing value
+    'k="unterminated',
+    "k v",           # space, not '='
+    '$bad=1',        # invalid key char
+    'k="v"x',        # missing whitespace after quoted value
+])
+def test_parse_key_vals_errors(inp):
+    with pytest.raises(TextParseError):
+        parse_key_vals(inp)

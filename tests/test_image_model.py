@@ -1,0 +1,75 @@
+import json
+
+from makisu_tpu.docker import image
+
+
+def test_parse_name_variants():
+    cases = {
+        "alpine": ("", "alpine", "latest"),
+        "alpine:3.9": ("", "alpine", "3.9"),
+        "user/repo:tag": ("", "user/repo", "tag"),
+        "registry.example.com/user/repo:tag":
+            ("registry.example.com", "user/repo", "tag"),
+        "localhost:5000/repo": ("localhost:5000", "repo", "latest"),
+        "localhost:5000/repo:t": ("localhost:5000", "repo", "t"),
+        "repo@sha256:" + "a" * 64: ("", "repo", "sha256:" + "a" * 64),
+        "reg.io/repo:tag@sha256:" + "b" * 64:
+            ("reg.io", "repo", "sha256:" + "b" * 64),
+    }
+    for s, (reg, repo, tag) in cases.items():
+        n = image.ImageName.parse(s)
+        assert (n.registry, n.repository, n.tag) == (reg, repo, tag), s
+
+
+def test_parse_for_pull_defaults():
+    n = image.ImageName.parse_for_pull("alpine:3.9")
+    assert n.registry == image.DOCKERHUB_REGISTRY
+    assert n.repository == "library/alpine"
+    n2 = image.ImageName.parse_for_pull("someorg/thing")
+    assert n2.repository == "someorg/thing"
+    assert image.ImageName.parse_for_pull("scratch").is_scratch
+
+
+def test_name_string_roundtrip():
+    n = image.ImageName.parse("reg.io:443/a/b:v1")
+    assert str(n) == "reg.io:443/a/b:v1"
+    d = image.ImageName.parse("reg.io/a@sha256:" + "c" * 64)
+    assert str(d) == "reg.io/a@sha256:" + "c" * 64
+
+
+def test_config_roundtrip():
+    cfg = image.ImageConfig()
+    cfg.config.env = ["PATH=/usr/bin", "FOO=bar"]
+    cfg.config.entrypoint = ["/bin/sh"]
+    cfg.config.exposed_ports = {"80/tcp": {}}
+    cfg.history.append(image.History(created_by="RUN x", empty_layer=True))
+    cfg.rootfs.diff_ids = ["sha256:" + "d" * 64]
+    blob = cfg.to_bytes()
+    back = image.ImageConfig.from_bytes(blob)
+    assert back.to_bytes() == blob
+    assert back.config.env == cfg.config.env
+    assert back.history[0].empty_layer
+
+
+def test_manifest_build_and_digest():
+    config_blob = b'{"a":1}'
+    pair = image.DigestPair(
+        tar_digest=image.Digest.from_hex("e" * 64),
+        gzip_descriptor=image.Descriptor(
+            image.MEDIA_TYPE_LAYER, 123, image.Digest.from_hex("f" * 64)),
+    )
+    m = image.DistributionManifest.build(config_blob, [pair])
+    d = json.loads(m.to_bytes())
+    assert d["schemaVersion"] == 2
+    assert d["config"]["digest"] == image.Digest.of_bytes(config_blob)
+    assert d["layers"][0]["size"] == 123
+    m2 = image.DistributionManifest.from_bytes(m.to_bytes())
+    assert m2.to_bytes() == m.to_bytes()
+    m.digest().validate()
+
+
+def test_digester_stream():
+    dg = image.Digester()
+    dg.write(b"hello ")
+    dg.write(b"world")
+    assert dg.digest() == image.Digest.of_bytes(b"hello world")

@@ -1,0 +1,67 @@
+import numpy as np
+
+from makisu_tpu.ops import gear
+
+
+def test_windowed_equals_sequential():
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=512, dtype=np.uint8)
+    got = np.asarray(gear.gear_hash(data))
+    want = gear.gear_hash_ref(data.tobytes())
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_matches_per_row():
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(4, 256), dtype=np.uint8)
+    got = np.asarray(gear.gear_hash(data))
+    for i in range(4):
+        np.testing.assert_array_equal(got[i], gear.gear_hash_ref(data[i].tobytes()))
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(5)
+    bits = rng.random((3, 96)) < 0.1
+    packed = np.asarray(gear.pack_bits(bits))
+    assert packed.shape == (3, 3)
+    back = gear.unpack_bits_np(packed, 96)
+    np.testing.assert_array_equal(back, bits)
+
+
+def test_bitmap_candidates_match_reference_recurrence():
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, size=4096, dtype=np.uint8)
+    packed = np.asarray(gear.gear_bitmap(data, avg_bits=6))
+    cand = np.flatnonzero(gear.unpack_bits_np(packed, data.size))
+    href = gear.gear_hash_ref(data.tobytes())
+    want = np.flatnonzero((href & np.uint32(63)) == 0)
+    np.testing.assert_array_equal(cand, want)
+
+
+def test_select_boundaries_min_max():
+    # Candidates violating min spacing get skipped; oversize gaps get split.
+    cuts = gear.select_boundaries_np(
+        np.array([5, 9, 30, 200]), n=500, min_size=10, max_size=64)
+    # end offsets: 5+1=6 skipped (<10); 10, 31, 201 valid after policy
+    assert cuts[0] >= 10
+    assert all(np.diff(np.concatenate([[0], cuts])) <= 64)
+    assert all(np.diff(np.concatenate([[0], cuts])) > 0)
+    assert cuts[-1] == 500
+
+
+def test_select_boundaries_deterministic_and_covering():
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=200_000, dtype=np.uint8)
+    packed = np.asarray(gear.gear_bitmap(data))
+    cand = np.flatnonzero(gear.unpack_bits_np(packed, data.size))
+    cuts = gear.select_boundaries_np(cand, data.size)
+    assert cuts[-1] == data.size
+    sizes = np.diff(np.concatenate([[0], cuts]))
+    assert (sizes > 0).all() and (sizes <= gear.DEFAULT_MAX_SIZE).all()
+    cuts2 = gear.select_boundaries_np(cand, data.size)
+    np.testing.assert_array_equal(cuts, cuts2)
+
+
+def test_empty_stream():
+    cuts = gear.select_boundaries_np(np.array([], dtype=np.int64), n=0)
+    np.testing.assert_array_equal(cuts, [0])

@@ -1,0 +1,47 @@
+import hashlib
+
+import numpy as np
+import pytest
+
+from makisu_tpu.ops import sha256
+
+
+def _lanes_from_messages(msgs, cap):
+    L = len(msgs)
+    data = np.zeros((L, cap), dtype=np.uint8)
+    lengths = np.zeros(L, dtype=np.int32)
+    for i, m in enumerate(msgs):
+        data[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lengths[i] = len(m)
+    return data, lengths
+
+
+@pytest.mark.parametrize("cap", [64, 256])
+def test_boundary_lengths_match_hashlib(cap):
+    msgs = [b"" if n == 0 else bytes(range(256)) * (n // 256 + 1)
+            for n in range(0, cap - 9)]
+    msgs = [m[:n] for n, m in enumerate(msgs)]
+    data, lengths = _lanes_from_messages(msgs, cap)
+    out = np.asarray(sha256.sha256_lanes(data, lengths))
+    got = sha256.digest_hex(out)
+    want = [hashlib.sha256(m).hexdigest() for m in msgs]
+    assert got == want
+
+
+def test_random_ragged_lanes():
+    rng = np.random.default_rng(7)
+    cap = 1024
+    msgs = [rng.integers(0, 256, size=int(n), dtype=np.uint8).tobytes()
+            for n in rng.integers(0, cap - 9, size=64)]
+    data, lengths = _lanes_from_messages(msgs, cap)
+    out = np.asarray(sha256.sha256_lanes(data, lengths))
+    assert sha256.digest_hex(out) == [hashlib.sha256(m).hexdigest() for m in msgs]
+
+
+def test_known_vectors():
+    data, lengths = _lanes_from_messages([b"abc", b"hello world"], 64)
+    out = sha256.digest_hex(np.asarray(sha256.sha256_lanes(data, lengths)))
+    assert out[0] == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+    assert out[1] == hashlib.sha256(b"hello world").hexdigest()

@@ -1,0 +1,25 @@
+import pytest
+
+from makisu_tpu.utils import pathutils as pu
+
+
+def test_abs_rel():
+    assert pu.abs_path("a/b") == "/a/b"
+    assert pu.abs_path("/a//b/../c") == "/a/c"
+    assert pu.rel_path("/a/b") == "a/b"
+
+
+def test_trim_join_root():
+    assert pu.trim_root("/root/x/a/b", "/root/x") == "/a/b"
+    assert pu.trim_root("/root/x", "/root/x") == "/"
+    assert pu.join_root("/sandbox", "/a/b") == "/sandbox/a/b"
+    with pytest.raises(ValueError):
+        pu.trim_root("/other/a", "/root/x")
+
+
+def test_descendants_and_ancestors():
+    assert pu.is_descendant_of_any("/proc/1", ["/proc", "/sys"])
+    assert pu.is_descendant_of_any("/proc", ["/proc"])
+    assert not pu.is_descendant_of_any("/procx", ["/proc"])
+    assert pu.ancestors("/a/b/c") == ["/a", "/a/b"]
+    assert pu.ancestors("/a") == []
