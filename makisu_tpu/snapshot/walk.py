@@ -1,0 +1,167 @@
+"""Filesystem walking, skip rules, and in-root symlink resolution.
+
+Reference capability: lib/snapshot/utils.go (shouldSkip, walk,
+removeAllChildren, evalSymlinks/walkLinks, CreateTarFromDirectory).
+"""
+
+from __future__ import annotations
+
+import os
+import stat as statmod
+import tarfile
+
+from makisu_tpu import tario
+from makisu_tpu.utils import mountinfo, pathutils, sysutils
+
+WHITEOUT_PREFIX = ".wh."
+WHITEOUT_META_PREFIX = ".wh..wh."
+
+
+def should_skip(path: str, st: os.stat_result | None,
+                blacklist: list[str]) -> bool:
+    """Paths that never participate in snapshots: AUFS whiteout metadata,
+    blacklisted trees, special files, and mount points."""
+    if os.path.basename(path).startswith(WHITEOUT_META_PREFIX):
+        return True
+    if pathutils.is_descendant_of_any(path, blacklist):
+        return True
+    if st is not None and sysutils.is_special_file(st):
+        return True
+    return mountinfo.is_mountpoint(path)
+
+
+def walk(src_root: str, blacklist: list[str] | None, fn) -> None:
+    """Depth-first lexical walk calling ``fn(path, stat)``; prunes skipped
+    directories. Includes ``src_root`` itself (like filepath.Walk)."""
+    blacklist = blacklist or []
+
+    def visit(path: str) -> None:
+        st = os.lstat(path)
+        if should_skip(path, st, blacklist):
+            return
+        fn(path, st)
+        if os.path.isdir(path) and not os.path.islink(path):
+            for name in sorted(os.listdir(path)):
+                visit(os.path.join(path, name))
+
+    visit(src_root)
+
+
+def remove_all_children(src_root: str, blacklist: list[str]) -> None:
+    """Delete everything under src_root except skipped paths, keeping any
+    directory that still holds a surviving (blacklisted/mounted) child."""
+
+    def remove(path: str) -> bool:
+        try:
+            st = os.lstat(path)
+        except OSError:
+            return True  # already gone
+        if should_skip(path, st, blacklist):
+            return False  # kept; ancestors must survive too
+        if not os.path.isdir(path) or os.path.islink(path):
+            try:
+                os.remove(path)
+                return True
+            except OSError:
+                return False
+        ok = True
+        for name in os.listdir(path):
+            if not remove(os.path.join(path, name)):
+                ok = False
+        if not ok:
+            return False
+        try:
+            os.rmdir(path)
+            return True
+        except OSError:
+            return False
+
+    for name in os.listdir(src_root):
+        remove(os.path.join(src_root, name))
+
+
+def eval_symlinks(path: str, root: str) -> str:
+    """Resolve symlinks of a root-relative path *within* root, returning the
+    absolute logical path. Links may not escape the root; loops error."""
+    if not path:
+        return path
+    resolved: list[str] = []
+    walked = 0
+    pending = pathutils.split_path(path)
+    while pending:
+        part = pending.pop(0)
+        cur_logical = "/" + "/".join(resolved + [part])
+        cur_disk = pathutils.join_root(root, cur_logical)
+        try:
+            st = os.lstat(cur_disk)
+        except FileNotFoundError:
+            resolved.append(part)
+            continue
+        if not os.path.islink(cur_disk):
+            resolved.append(part)
+            continue
+        walked += 1
+        if walked > 255:
+            raise OSError(f"eval symlinks: too many links at {path}")
+        target = os.readlink(cur_disk)
+        if os.path.isabs(target):
+            if target.startswith(root.rstrip("/") + "/") or target == root:
+                target = pathutils.trim_root(target, root)
+            resolved = []
+        pending = pathutils.split_path(target) + pending
+    return "/" + "/".join(resolved)
+
+
+def create_tar_from_directory(target: str, src_dir: str) -> None:
+    """Gzip-tar a directory tree with hardlink dedup by inode
+    (reference: CreateTarFromDirectory utils.go:156)."""
+    inodes: dict[int, str] = {}
+    with open(target, "wb") as f:
+        with tario.gzip_writer(f) as gz:
+            with tarfile.open(fileobj=gz, mode="w|") as tw:
+                def one(path: str, st: os.stat_result) -> None:
+                    if path == src_dir:
+                        return
+                    name = pathutils.rel_path(
+                        pathutils.trim_root(path, src_dir))
+                    hdr = tarinfo_from_stat(path, name, src_dir)
+                    if hdr.isreg():
+                        if st.st_ino in inodes:
+                            hdr.type = tarfile.LNKTYPE
+                            hdr.linkname = inodes[st.st_ino]
+                            hdr.size = 0
+                        else:
+                            inodes[st.st_ino] = hdr.name
+                    tario.write_entry(tw, path, hdr)
+
+                walk(src_dir, None, one)
+
+
+def tarinfo_from_stat(src: str, name: str, root: str) -> tarfile.TarInfo:
+    """Build a TarInfo from an on-disk path.
+
+    Directory names get docker's trailing slash; absolute symlink targets
+    are rebased to be root-relative (reference: memLayer.createHeader,
+    mem_layer.go:~110-140).
+    """
+    st = os.lstat(src)
+    hdr = tarfile.TarInfo(name)
+    hdr.mode = st.st_mode & 0o7777
+    hdr.uid = st.st_uid
+    hdr.gid = st.st_gid
+    hdr.mtime = int(st.st_mtime)
+    hdr.uname = ""
+    hdr.gname = ""
+    if statmod.S_ISDIR(st.st_mode):
+        # (tarfile adds docker's trailing slash to dir names at write time)
+        hdr.type = tarfile.DIRTYPE
+    elif statmod.S_ISLNK(st.st_mode):
+        hdr.type = tarfile.SYMTYPE
+        target = os.readlink(src)
+        if os.path.isabs(target):
+            target = pathutils.trim_root(target, root)
+        hdr.linkname = target
+    else:
+        hdr.type = tarfile.REGTYPE
+        hdr.size = st.st_size
+    return hdr

@@ -1,0 +1,101 @@
+"""Layer-tar I/O: deterministic gzip, header apply/compare/write.
+
+Reference capability: lib/tario/ (gzip levels gzip.go:26-47, ApplyHeader
+apply.go:26, IsSimilarHeader compare.go:24-104, WriteEntry write.go:28,
+untar untar.go:33). Python's tarfile.TarInfo is the header record
+throughout the framework.
+
+Determinism note: gzip output is part of a layer's registry identity, so
+the writer pins mtime=0 and omits the filename — identical tar bytes at the
+same compression level always produce identical gzip bytes.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+from typing import BinaryIO
+
+# Compression levels mirror the reference's flag surface
+# (no/speed/default/size → tario.CompressionLevel, gzip.go:26-47).
+COMPRESSION_LEVELS = {"no": 0, "speed": 1, "default": 6, "size": 9}
+
+_compression_level = COMPRESSION_LEVELS["default"]
+
+
+def set_compression(name: str) -> None:
+    global _compression_level
+    try:
+        _compression_level = COMPRESSION_LEVELS[name]
+    except KeyError:
+        raise ValueError(
+            f"invalid compression level {name!r}; "
+            f"one of {sorted(COMPRESSION_LEVELS)}") from None
+
+
+def compression_level() -> int:
+    return _compression_level
+
+
+def gzip_writer(fileobj: BinaryIO, level: int | None = None) -> gzip.GzipFile:
+    level = _compression_level if level is None else level
+    return gzip.GzipFile(fileobj=fileobj, mode="wb", compresslevel=level,
+                         mtime=0, filename="")
+
+
+def gzip_reader(fileobj: BinaryIO) -> gzip.GzipFile:
+    return gzip.GzipFile(fileobj=fileobj, mode="rb")
+
+
+def is_similar_header(h: tarfile.TarInfo, nh: tarfile.TarInfo,
+                      ignore_time: bool = False) -> bool:
+    """Structural equality by file type — the cheap "did this change?"
+    predicate behind both the scan diff and untar short-circuiting.
+
+    Regular files compare (mtime, uid, gid, size, mode); directories and
+    hardlinks the same minus/plus size/linkname; symlinks compare the link
+    target only. mtimes compare at 1-second granularity (tar's resolution).
+    """
+    if not h.name and not nh.name:
+        return True  # "/" itself is never modified
+    if h.issym():
+        return nh.issym() and h.linkname == nh.linkname
+    time_ok = ignore_time or int(h.mtime) == int(nh.mtime)
+    if h.islnk():
+        return (nh.islnk() and time_ok and h.linkname == nh.linkname
+                and h.uid == nh.uid and h.gid == nh.gid and h.mode == nh.mode)
+    if h.isdir():
+        return (nh.isdir() and time_ok and h.uid == nh.uid
+                and h.gid == nh.gid and h.mode == nh.mode)
+    if h.isreg():
+        return (nh.isreg() and time_ok and h.uid == nh.uid and h.gid == nh.gid
+                and h.size == nh.size and h.mode == nh.mode)
+    raise ValueError(f"unsupported tar entry type {h.type!r} for {h.name}")
+
+
+def apply_header(path: str, h: tarfile.TarInfo) -> None:
+    """Apply header metadata (mode/owner/mtime) to an on-disk path."""
+    if not h.issym():
+        os.chmod(path, h.mode)
+    try:
+        os.lchown(path, h.uid, h.gid)
+    except PermissionError:
+        pass  # unprivileged runs keep the current owner
+    if not h.issym():
+        os.utime(path, (h.mtime, h.mtime))
+
+
+def write_entry(tw: tarfile.TarFile, src: str, h: tarfile.TarInfo) -> None:
+    """Write one entry; regular-file content streams from ``src``."""
+    if h.isreg() and h.size > 0:
+        with open(src, "rb") as f:
+            tw.addfile(h, f)
+    else:
+        tw.addfile(h)
+
+
+def untar(tf: tarfile.TarFile, dest: str) -> None:
+    """Plain untar into dest (no whiteout handling; reference untar.go:33)."""
+    for member in tf:
+        tf.extract(member, dest, set_attrs=True)

@@ -1,0 +1,135 @@
+"""Recursive file copying with ownership policies.
+
+Reference capability: lib/fileio/copy.go (Copier, WithDstDirOwner:98,
+WithDstFileAndChildrenOwner:108). Behavior preserved: blacklist pruning,
+symlinks copied as links (never chowned), special files skipped, existing
+destinations overwritten, dst dirs created 0755/root by default, ownership
+override policies for COPY --chown / context copies / --archive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+
+from makisu_tpu.utils import pathutils, sysutils
+
+
+@dataclasses.dataclass(frozen=True)
+class Owner:
+    uid: int
+    gid: int
+    overwrite: bool  # force this owner instead of the source's
+
+
+def _chown(path: str, uid: int, gid: int, follow_symlinks=True) -> None:
+    try:
+        os.chown(path, uid, gid, follow_symlinks=follow_symlinks)
+    except PermissionError:
+        pass  # unprivileged builds keep current ownership
+
+
+class Copier:
+    """Copies files/trees, applying destination ownership policies.
+
+    ``dir_owner`` applies to the destination directory itself (and any
+    directories created along the way when ``overwrite``); ``file_owner``
+    applies to copied files and, when ``overwrite``, to every child.
+    """
+
+    def __init__(self, blacklist: list[str] | None = None,
+                 dir_owner: Owner | None = None,
+                 file_owner: Owner | None = None) -> None:
+        self.blacklist = list(blacklist or [])
+        self.dir_owner = dir_owner
+        self.file_owner = file_owner
+
+    def _blacklisted(self, p: str) -> bool:
+        return pathutils.is_descendant_of_any(p, self.blacklist)
+
+    def copy_file(self, src: str, dst: str) -> None:
+        self._mkdir_ancestors(os.path.dirname(dst))
+        self._copy_file(src, dst)
+
+    def copy_dir(self, src: str, dst: str) -> None:
+        if self._blacklisted(src):
+            return
+        self._mkdir_ancestors(os.path.dirname(dst))
+        self._ensure_dir(src, dst, top=True)
+        self._copy_dir_contents(src, dst, dst)
+
+    # -- internals --------------------------------------------------------
+
+    def _mkdir_ancestors(self, dst: str) -> None:
+        """Create missing ancestor dirs with default mode 0755, root-owned."""
+        dst = os.path.abspath(dst)
+        parts = pathutils.split_path(dst)
+        cur = "/"
+        for part in parts:
+            cur = os.path.join(cur, part)
+            if not os.path.lexists(cur):
+                os.mkdir(cur, 0o755)
+                _chown(cur, 0, 0)
+
+    def _ensure_dir(self, src: str, dst: str, top: bool) -> None:
+        """Create/update one destination directory from a source directory."""
+        st = os.lstat(src)
+        if not os.path.lexists(dst):
+            os.mkdir(dst, st.st_mode & 0o7777)
+        elif not os.path.isdir(dst):
+            raise NotADirectoryError(f"dst {dst} is not a directory")
+        uid, gid = st.st_uid, st.st_gid
+        owner = self.dir_owner if top else None
+        if owner is None and self.file_owner and self.file_owner.overwrite:
+            owner = self.file_owner
+        if owner is not None:
+            uid, gid = owner.uid, owner.gid
+        _chown(dst, uid, gid)
+        os.chmod(dst, st.st_mode & 0o7777)
+
+    def _copy_dir_contents(self, src: str, dst: str, orig_dst: str) -> None:
+        for name in sorted(os.listdir(src)):
+            cur_src = os.path.join(src, name)
+            if self._blacklisted(cur_src) or cur_src == orig_dst:
+                continue  # orig_dst check breaks dst-inside-src loops
+            cur_dst = os.path.join(dst, name)
+            if os.path.isdir(cur_src) and not os.path.islink(cur_src):
+                self._ensure_dir(cur_src, cur_dst, top=False)
+                self._copy_dir_contents(cur_src, cur_dst, orig_dst)
+            else:
+                self._copy_file(cur_src, cur_dst)
+
+    def _copy_file(self, src: str, dst: str) -> None:
+        if self._blacklisted(src):
+            return
+        st = os.lstat(src)
+        if os.path.islink(src):
+            if os.path.lexists(dst):
+                os.remove(dst)
+            os.symlink(os.readlink(src), dst)
+            return  # symlinks are never chowned/chmodded
+        if sysutils.is_special_file(st):
+            return
+        if os.path.lexists(dst) and not os.path.isdir(dst):
+            os.chmod(dst, 0o777)
+        with open(src, "rb") as r, open(dst, "wb") as w:
+            shutil.copyfileobj(r, w)
+        uid, gid = st.st_uid, st.st_gid
+        if self.file_owner and self.file_owner.overwrite:
+            uid, gid = self.file_owner.uid, self.file_owner.gid
+        _chown(dst, uid, gid)
+        os.chmod(dst, st.st_mode & 0o7777)
+
+
+def reader_to_file(reader, dst: str) -> int:
+    """Stream a file-like reader to dst (reference: fileio.ReaderToFile:35)."""
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    n = 0
+    with open(dst, "wb") as f:
+        while True:
+            chunk = reader.read(1 << 20)
+            if not chunk:
+                return n
+            f.write(chunk)
+            n += len(chunk)
