@@ -1,0 +1,29 @@
+"""The layer-commit hashing seam.
+
+Every byte of every committed layer tar flows through a ``Hasher`` here —
+the exact splice point of the reference's hot loop (tarAndGzipDiffs,
+lib/builder/step/common.go:35-64). Two implementations:
+
+- ``CPUHasher``: dual streaming SHA-256 (tar diffID + gzip blob digest)
+  plus gzip, byte-for-byte what the reference computes.
+- ``TPUHasher``: the CPU pair plus Gear content-defined chunking and
+  lane-parallel per-chunk SHA-256 on the accelerator (ops/gear, ops/sha256
+  via chunker.cdc), producing chunk fingerprints for the chunk-granular
+  distributed cache (the reference caches whole layers only,
+  lib/cache/cache_manager.go:39-40).
+"""
+
+from makisu_tpu.chunker.hasher import (
+    ChunkFingerprint,
+    CPUHasher,
+    Hasher,
+    LayerCommit,
+    LayerSink,
+    TPUHasher,
+    get_hasher,
+)
+
+__all__ = [
+    "ChunkFingerprint", "CPUHasher", "Hasher", "LayerCommit", "LayerSink",
+    "TPUHasher", "get_hasher",
+]

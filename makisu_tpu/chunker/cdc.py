@@ -1,0 +1,192 @@
+"""Streaming content-defined chunking + lane-parallel chunk hashing.
+
+A ``ChunkSession`` consumes an arbitrarily long byte stream in fixed-size
+blocks and produces content-defined chunks with SHA-256 fingerprints:
+
+1. Each block ships to the accelerator once; ``ops.gear.gear_bitmap``
+   returns a bit-packed candidate-boundary bitmap (3% readback). Blocks
+   after the first carry a ``WINDOW``-byte halo from the previous block so
+   per-position hashes are identical to one continuous stream.
+2. A greedy host pass applies min/max chunk-size policy to the candidate
+   positions (cheap: a few comparisons per candidate, not per byte).
+3. Chunk bytes batch into fixed-shape lane buffers — bucketed capacities
+   so XLA compiles one program per bucket, never per input — and hash in
+   lock-step on the VPU (``ops.sha256.sha256_lanes``).
+
+Everything dispatches asynchronously; device→host syncs happen only for
+bitmap readback and at ``finish()``.
+
+This is the long-stream scaling design the reference lacks (its hashing is
+a single sequential SHA-256 stream, lib/builder/step/common.go:35-67); see
+SURVEY.md §5 "long-context" mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from makisu_tpu.ops import gear, sha256
+
+BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
+
+# Lane-buffer buckets: (capacity, lanes). Chunk avg is 8 KiB and max
+# 64 KiB, so most chunks hash in the 16 KiB bucket; each bucket is one
+# compiled XLA program reused forever.
+_BUCKETS = ((16 * 1024, 512), (gear.DEFAULT_MAX_SIZE + 64, 128))
+
+
+@dataclasses.dataclass(frozen=True)
+class Chunk:
+    offset: int
+    length: int
+    digest: bytes  # 32-byte sha256
+
+    @property
+    def hex(self) -> str:
+        return self.digest.hex()
+
+
+class _LaneBatcher:
+    """Accumulates chunks into one bucket's fixed [L, CAP] buffer and
+    dispatches sha256_lanes when full."""
+
+    def __init__(self, cap: int, lanes: int) -> None:
+        self.cap = cap
+        self.lanes = lanes
+        self.data = np.zeros((lanes, cap), dtype=np.uint8)
+        self.lengths = np.zeros(lanes, dtype=np.int32)
+        self.meta: list[tuple[int, int]] = []  # (offset, length)
+        self.pending: list[tuple[jax.Array, list[tuple[int, int]]]] = []
+
+    def add(self, off: int, data: memoryview) -> None:
+        i = len(self.meta)
+        n = len(data)
+        self.data[i, :n] = np.frombuffer(data, dtype=np.uint8)
+        self.data[i, n:] = 0
+        self.lengths[i] = n
+        self.meta.append((off, n))
+        if len(self.meta) == self.lanes:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self.meta:
+            return
+        digests = sha256.sha256_lanes(
+            self.data, self.lengths)  # async dispatch
+        self.pending.append((digests, self.meta))
+        self.meta = []
+        # Fresh buffers: the dispatched call may still be consuming the old
+        # host arrays.
+        self.data = np.zeros((self.lanes, self.cap), dtype=np.uint8)
+        self.lengths = np.zeros(self.lanes, dtype=np.int32)
+
+    def drain(self) -> list[Chunk]:
+        self.flush()
+        out: list[Chunk] = []
+        for digests, meta in self.pending:
+            host = np.asarray(digests)  # sync point
+            for i, (off, n) in enumerate(meta):
+                out.append(Chunk(off, n, host[i].astype(">u4").tobytes()))
+        self.pending = []
+        return out
+
+
+class ChunkSession:
+    """One layer stream → content-defined chunks with fingerprints."""
+
+    def __init__(self, avg_bits: int = gear.DEFAULT_AVG_BITS,
+                 min_size: int = gear.DEFAULT_MIN_SIZE,
+                 max_size: int = gear.DEFAULT_MAX_SIZE,
+                 block: int = BLOCK) -> None:
+        if block % 32:
+            raise ValueError("block size must be a multiple of 32")
+        self.avg_bits = avg_bits
+        self.min_size = min_size
+        self.max_size = max_size
+        self.block = block
+        self._staging = bytearray()   # bytes not yet gear-scanned
+        self._tail = bytearray()      # scanned bytes after the last cut
+        self._tail_offset = 0         # stream offset of _tail[0]
+        self._scanned = 0             # stream bytes gear-scanned so far
+        self._halo = b""              # last WINDOW bytes of previous block
+        self._prev_cut = 0            # stream offset of the last cut
+        self._batchers = [_LaneBatcher(cap, lanes)
+                          for cap, lanes in _BUCKETS]
+        self._chunks: list[Chunk] = []
+
+    # -- byte intake ------------------------------------------------------
+
+    def update(self, data: bytes) -> None:
+        self._staging.extend(data)
+        while len(self._staging) >= self.block:
+            blk = bytes(self._staging[:self.block])
+            del self._staging[:self.block]
+            self._scan_block(blk)
+
+    def finish(self) -> list[Chunk]:
+        if self._staging:
+            blk = bytes(self._staging)
+            pad = (-len(blk)) % 32
+            self._scan_block(blk + b"\x00" * pad, live=len(blk))
+            self._staging.clear()
+        # Final chunk: whatever follows the last cut.
+        if self._tail:
+            self._emit(bytes(self._tail), self._tail_offset)
+            self._tail.clear()
+        for b in self._batchers:
+            self._chunks.extend(b.drain())
+        self._chunks.sort(key=lambda c: c.offset)
+        return self._chunks
+
+    # -- internals --------------------------------------------------------
+
+    def _scan_block(self, blk: bytes, live: int | None = None) -> None:
+        """Gear-scan one block (plus halo) and cut chunks at candidates."""
+        live = len(blk) if live is None else live
+        halo = self._halo
+        buf = np.frombuffer(halo + blk, dtype=np.uint8)
+        words = np.asarray(gear.gear_bitmap(buf, self.avg_bits))
+        bits = gear.unpack_bits_np(words, len(buf))[len(halo):len(halo) + live]
+        base = self._scanned  # stream offset of blk[0]
+        candidates = np.nonzero(bits)[0] + base
+        self._tail.extend(blk[:live])
+        for pos in candidates:
+            end = int(pos) + 1  # cut AFTER the boundary byte
+            self._cut_to(end)
+        # Oversize tail without candidates: force max-size cuts.
+        while len(self._tail) > self.max_size:
+            self._force_cut(self._tail_offset + self.max_size)
+        self._scanned += live
+        self._halo = (halo + blk)[-(gear.WINDOW):]
+
+    def _cut_to(self, end: int) -> None:
+        if end - self._prev_cut < self.min_size:
+            return
+        while end - self._prev_cut > self.max_size:
+            self._force_cut(self._prev_cut + self.max_size)
+        if end - self._prev_cut >= self.min_size:
+            self._take(end)
+
+    def _force_cut(self, end: int) -> None:
+        self._take(end)
+
+    def _take(self, end: int) -> None:
+        n = end - self._tail_offset
+        if n <= 0:
+            return
+        data = bytes(self._tail[:n])
+        del self._tail[:n]
+        self._emit(data, self._tail_offset)
+        self._tail_offset = end
+        self._prev_cut = end
+
+    def _emit(self, data: bytes, offset: int) -> None:
+        for b in self._batchers:
+            if len(data) <= b.cap - 64:  # leave room for sha padding
+                b.add(offset, memoryview(data))
+                return
+        raise AssertionError(
+            f"chunk of {len(data)} bytes exceeds every lane bucket")

@@ -1,0 +1,156 @@
+"""Hasher implementations behind the layer-commit seam.
+
+``LayerSink`` is the writable object a layer tar streams into; ``finish()``
+yields the layer's identity: tar digest (diffID), gzip blob descriptor, and
+(TPU path) content-defined chunk fingerprints.
+
+Reference hot path replaced: lib/builder/step/common.go tarAndGzipDiffs:35
+(tar bytes → two sequential SHA-256 digesters + pgzip via nested
+ConcurrentMultiWriters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import BinaryIO, Protocol
+
+from makisu_tpu import tario
+from makisu_tpu.docker.image import (
+    MEDIA_TYPE_LAYER,
+    Descriptor,
+    Digest,
+    DigestPair,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkFingerprint:
+    offset: int
+    length: int
+    hex_digest: str
+
+
+@dataclasses.dataclass
+class LayerCommit:
+    """Everything the cache/registry need to know about one layer."""
+
+    digest_pair: DigestPair
+    chunks: list[ChunkFingerprint]
+
+    @property
+    def chunk_ids(self) -> list[str]:
+        return [c.hex_digest for c in self.chunks]
+
+
+class _TeeDigest:
+    """File-like fanning writes to a digest and an underlying file."""
+
+    def __init__(self, out: BinaryIO) -> None:
+        self.out = out
+        self.digest = hashlib.sha256()
+        self.size = 0
+
+    def write(self, data: bytes) -> int:
+        self.digest.update(data)
+        self.size += len(data)
+        return self.out.write(data)
+
+    def flush(self) -> None:
+        self.out.flush()
+
+
+class LayerSink:
+    """CPU layer sink: gzip + (tar digest, gzip digest) streaming.
+
+    Subclasses tap the uncompressed tar stream for extra work.
+    """
+
+    def __init__(self, out: BinaryIO, compression_level: int | None = None
+                 ) -> None:
+        self._tar_digest = hashlib.sha256()
+        self._tee = _TeeDigest(out)
+        self._gz = tario.gzip_writer(self._tee, compression_level)
+        self._closed = False
+
+    def write(self, data: bytes) -> int:
+        self._tar_digest.update(data)
+        self._gz.write(data)
+        self._tap(data)
+        return len(data)
+
+    def _tap(self, data: bytes) -> None:  # pragma: no cover - hook
+        pass
+
+    def _finish_chunks(self) -> list[ChunkFingerprint]:
+        return []
+
+    def finish(self) -> LayerCommit:
+        if self._closed:
+            raise RuntimeError("layer sink already finished")
+        self._closed = True
+        self._gz.close()
+        self._tee.flush()
+        pair = DigestPair(
+            tar_digest=Digest.from_hex(self._tar_digest.hexdigest()),
+            gzip_descriptor=Descriptor(
+                MEDIA_TYPE_LAYER, self._tee.size,
+                Digest.from_hex(self._tee.digest.hexdigest())))
+        return LayerCommit(pair, self._finish_chunks())
+
+
+class Hasher(Protocol):
+    """Factory for layer sinks; chosen once per build."""
+
+    name: str
+
+    def open_layer(self, out: BinaryIO) -> LayerSink: ...
+
+
+class CPUHasher:
+    """Parity with the reference: digests only, no chunking."""
+
+    name = "cpu"
+
+    def open_layer(self, out: BinaryIO) -> LayerSink:
+        return LayerSink(out)
+
+
+class _TPUSink(LayerSink):
+    def __init__(self, out: BinaryIO, session) -> None:
+        super().__init__(out)
+        self._session = session
+
+    def _tap(self, data: bytes) -> None:
+        self._session.update(data)
+
+    def _finish_chunks(self) -> list[ChunkFingerprint]:
+        return [ChunkFingerprint(c.offset, c.length, c.hex)
+                for c in self._session.finish()]
+
+
+class TPUHasher:
+    """CPU digests + accelerator-side CDC chunk fingerprints."""
+
+    name = "tpu"
+
+    def __init__(self, avg_bits: int | None = None,
+                 min_size: int | None = None,
+                 max_size: int | None = None) -> None:
+        from makisu_tpu.ops import gear
+        self.avg_bits = avg_bits or gear.DEFAULT_AVG_BITS
+        self.min_size = min_size or gear.DEFAULT_MIN_SIZE
+        self.max_size = max_size or gear.DEFAULT_MAX_SIZE
+
+    def open_layer(self, out: BinaryIO) -> LayerSink:
+        from makisu_tpu.chunker.cdc import ChunkSession
+        return _TPUSink(out, ChunkSession(
+            self.avg_bits, self.min_size, self.max_size))
+
+
+def get_hasher(name: str) -> Hasher:
+    if name == "cpu":
+        return CPUHasher()
+    if name == "tpu":
+        return TPUHasher()
+    raise ValueError(f"unknown hasher {name!r} (choose cpu or tpu)")

@@ -1,0 +1,141 @@
+"""Chunker seam tests: CPU digests, CDC determinism, chunk fingerprints.
+
+Hermetic on the JAX CPU backend per SURVEY.md §4's fake/CPU hasher
+strategy.
+"""
+
+import gzip
+import hashlib
+import io
+
+import numpy as np
+import pytest
+
+from makisu_tpu.chunker import CPUHasher, TPUHasher, get_hasher
+from makisu_tpu.chunker.cdc import ChunkSession
+from makisu_tpu.ops import gear
+
+
+def rand_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_cpu_hasher_digests_match_hashlib():
+    payload = rand_bytes(100_000, 1)
+    out = io.BytesIO()
+    sink = CPUHasher().open_layer(out)
+    for i in range(0, len(payload), 7777):
+        sink.write(payload[i:i + 7777])
+    commit = sink.finish()
+    assert commit.digest_pair.tar_digest.hex() == \
+        hashlib.sha256(payload).hexdigest()
+    blob = out.getvalue()
+    assert commit.digest_pair.gzip_descriptor.digest.hex() == \
+        hashlib.sha256(blob).hexdigest()
+    assert commit.digest_pair.gzip_descriptor.size == len(blob)
+    assert gzip.decompress(blob) == payload
+    assert commit.chunks == []
+
+
+def test_gzip_output_deterministic():
+    payload = rand_bytes(50_000, 2)
+    blobs = []
+    for _ in range(2):
+        out = io.BytesIO()
+        sink = CPUHasher().open_layer(out)
+        sink.write(payload)
+        sink.finish()
+        blobs.append(out.getvalue())
+    assert blobs[0] == blobs[1]
+
+
+def session_chunks(payload, block=64 * 1024, **kw):
+    s = ChunkSession(block=block, **kw)
+    step = 10_000
+    for i in range(0, len(payload), step):
+        s.update(payload[i:i + step])
+    return s.finish()
+
+
+def test_chunks_cover_stream_exactly():
+    payload = rand_bytes(300_000, 3)
+    chunks = session_chunks(payload)
+    assert chunks[0].offset == 0
+    for a, b in zip(chunks, chunks[1:]):
+        assert a.offset + a.length == b.offset
+    assert chunks[-1].offset + chunks[-1].length == len(payload)
+
+
+def test_chunk_digests_are_correct():
+    payload = rand_bytes(200_000, 4)
+    for c in session_chunks(payload):
+        want = hashlib.sha256(payload[c.offset:c.offset + c.length])
+        assert c.digest == want.digest()
+
+
+def test_chunk_sizes_respect_policy():
+    payload = rand_bytes(500_000, 5)
+    chunks = session_chunks(payload)
+    for c in chunks[:-1]:
+        assert gear.DEFAULT_MIN_SIZE <= c.length <= gear.DEFAULT_MAX_SIZE
+    assert chunks[-1].length <= gear.DEFAULT_MAX_SIZE
+
+
+def test_chunking_independent_of_block_size():
+    """Same stream, different block geometry → identical chunks (the halo
+    carry makes block joins invisible)."""
+    payload = rand_bytes(400_000, 6)
+    a = [(c.offset, c.length, c.digest) for c in
+         session_chunks(payload, block=32 * 1024)]
+    b = [(c.offset, c.length, c.digest) for c in
+         session_chunks(payload, block=128 * 1024)]
+    assert a == b
+
+
+def test_chunking_shift_resistance():
+    """Inserting bytes near the front must not re-chunk the far tail —
+    the core CDC property that powers chunk-granular cache dedup."""
+    payload = rand_bytes(600_000, 7)
+    shifted = payload[:1000] + b"INSERTED-PREFIX-BYTES" + payload[1000:]
+    d1 = {c.digest for c in session_chunks(payload)}
+    d2 = {c.digest for c in session_chunks(shifted)}
+    shared = len(d1 & d2)
+    assert shared / len(d1) > 0.5
+
+
+def test_constant_data_forced_cuts():
+    """All-zero data has no gear candidates; max-size forcing bounds every
+    chunk."""
+    payload = b"\x00" * 300_000
+    chunks = session_chunks(payload)
+    assert all(c.length <= gear.DEFAULT_MAX_SIZE for c in chunks)
+    assert sum(c.length for c in chunks) == len(payload)
+
+
+def test_empty_stream():
+    assert session_chunks(b"") == []
+
+
+def test_tpu_hasher_end_to_end():
+    payload = rand_bytes(150_000, 8)
+    out = io.BytesIO()
+    sink = TPUHasher().open_layer(out)
+    sink.write(payload)
+    commit = sink.finish()
+    assert commit.digest_pair.tar_digest.hex() == \
+        hashlib.sha256(payload).hexdigest()
+    assert commit.chunks
+    assert sum(c.length for c in commit.chunks) == len(payload)
+    # CPU and TPU hashers agree on the digest pair.
+    out2 = io.BytesIO()
+    s2 = CPUHasher().open_layer(out2)
+    s2.write(payload)
+    assert s2.finish().digest_pair == commit.digest_pair
+
+
+def test_get_hasher():
+    assert get_hasher("cpu").name == "cpu"
+    assert get_hasher("tpu").name == "tpu"
+    with pytest.raises(ValueError):
+        get_hasher("gpu")
