@@ -1,0 +1,8 @@
+"""Build orchestration (reference: lib/builder/)."""
+
+from makisu_tpu.builder.node import BuildNode, NodeOptions
+from makisu_tpu.builder.plan import BuildPlan
+from makisu_tpu.builder.stage import BuildStage, StageOptions
+
+__all__ = ["BuildNode", "BuildPlan", "BuildStage", "NodeOptions",
+           "StageOptions"]

@@ -1,0 +1,15 @@
+"""Distributed layer cache (reference: lib/cache/ + lib/cache/keyvalue/)."""
+
+from makisu_tpu.cache.kv import FSStore, HTTPStore, MemoryStore, RedisStore
+from makisu_tpu.cache.manager import (
+    EMPTY_ENTRY,
+    CacheManager,
+    NoopCacheManager,
+    decode_entry,
+    encode_entry,
+)
+
+__all__ = [
+    "CacheManager", "EMPTY_ENTRY", "FSStore", "HTTPStore", "MemoryStore",
+    "NoopCacheManager", "RedisStore", "decode_entry", "encode_entry",
+]

@@ -1,0 +1,144 @@
+"""Key-value stores backing the distributed cache.
+
+Reference: lib/cache/keyvalue/ (Store iface store.go:22-26; fsStore with
+TTL eviction + atomic writes fs_store.go:44-121; redisStore; httpStore
+with custom headers; in-memory mock). All stores map cache-ID strings to
+entry strings; correctness across builders relies only on idempotence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+class MemoryStore:
+    """In-memory store (tests and single-process builds)."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> str | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def cleanup(self) -> None:
+        pass
+
+
+class FSStore:
+    """Single-JSON-file store with TTL eviction on load and atomic
+    tmp+rename persistence (reference: fs_store.go)."""
+
+    def __init__(self, path: str, ttl_seconds: float = 336 * 3600) -> None:
+        self.path = path
+        self.ttl = ttl_seconds
+        self._lock = threading.Lock()
+        self._data: dict[str, tuple[str, float]] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return
+        now = time.time()
+        for key, (value, ts) in raw.items():
+            if now - ts < self.ttl:
+                self._data[key] = (value, ts)
+
+    def _persist_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self._data, f)
+        os.rename(tmp, self.path)
+
+    def get(self, key: str) -> str | None:
+        with self._lock:
+            hit = self._data.get(key)
+            if hit is None:
+                return None
+            value, ts = hit
+            if time.time() - ts >= self.ttl:
+                del self._data[key]
+                return None
+            return value
+
+    def put(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = (value, time.time())
+            self._persist_locked()
+
+    def cleanup(self) -> None:
+        with self._lock:
+            now = time.time()
+            self._data = {k: v for k, v in self._data.items()
+                          if now - v[1] < self.ttl}
+            self._persist_locked()
+
+
+class RedisStore:
+    """Redis-backed store with TTL (reference: redis_store.go). The redis
+    client is imported lazily so CPU-only deployments need no extra deps."""
+
+    def __init__(self, addr: str, ttl_seconds: float = 336 * 3600,
+                 password: str = "") -> None:
+        import redis  # deferred: optional dependency
+        host, _, port = addr.partition(":")
+        self._client = redis.Redis(host=host,
+                                   port=int(port) if port else 6379,
+                                   password=password or None)
+        self.ttl = int(ttl_seconds)
+
+    def get(self, key: str) -> str | None:
+        val = self._client.get(key)
+        return val.decode() if val is not None else None
+
+    def put(self, key: str, value: str) -> None:
+        self._client.set(key, value, ex=self.ttl)
+
+    def cleanup(self) -> None:
+        pass  # redis expires keys itself
+
+
+class HTTPStore:
+    """GET/PUT cache entries against an HTTP endpoint (reference:
+    http_store.go). ``address`` is ``host:port``; extra headers support
+    auth-fronted caches."""
+
+    def __init__(self, address: str, headers: dict[str, str] | None = None,
+                 timeout: float = 10.0) -> None:
+        self.base = address if "://" in address else "http://" + address
+        self.headers = dict(headers or {})
+        self.timeout = timeout
+
+    def _url(self, key: str) -> str:
+        return f"{self.base.rstrip('/')}/{key}"
+
+    def get(self, key: str) -> str | None:
+        req = urllib.request.Request(self._url(key), headers=self.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except OSError:
+            return None
+
+    def put(self, key: str, value: str) -> None:
+        req = urllib.request.Request(
+            self._url(key), data=value.encode(), method="PUT",
+            headers=self.headers)
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def cleanup(self) -> None:
+        pass

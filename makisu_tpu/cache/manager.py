@@ -1,0 +1,159 @@
+"""Cache manager: map chained cache IDs to layers, async push.
+
+Reference: lib/cache/cache_manager.go (registryCacheManager: mem-map + KV
+(3 tries) + local store + registry PullLayer :116-182; async push
+goroutines :184-222; WaitForPush 10-min bound :225-237; empty sentinel
+:35,144; noop impl :47-62).
+
+Entry schema is JSON — richer than the reference's "tarsha,gzipsha" string
+because entries also carry the layer's chunk fingerprints, which is what
+makes chunk-granular dedup possible downstream.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from makisu_tpu.chunker.hasher import LayerCommit
+from makisu_tpu.docker.image import (
+    MEDIA_TYPE_LAYER,
+    Descriptor,
+    Digest,
+    DigestPair,
+)
+from makisu_tpu.utils import logging as log
+
+EMPTY_ENTRY = "MAKISU_TPU_CACHE_EMPTY"  # a step that committed no layer
+_KV_RETRIES = 3
+
+
+class CacheMiss(KeyError):
+    """No entry for this cache ID — breaks the stage's prefetch chain
+    (distinct from the EMPTY sentinel, which continues it)."""
+
+
+def encode_entry(pair: DigestPair | None,
+                 commit: LayerCommit | None = None) -> str:
+    if pair is None:
+        return EMPTY_ENTRY
+    entry = {
+        "tar": str(pair.tar_digest),
+        "gzip": str(pair.gzip_descriptor.digest),
+        "size": pair.gzip_descriptor.size,
+    }
+    if commit is not None and commit.chunks:
+        entry["chunks"] = [[c.offset, c.length, c.hex_digest]
+                           for c in commit.chunks]
+    return json.dumps(entry, separators=(",", ":"))
+
+
+def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
+    if raw == EMPTY_ENTRY:
+        return None, []
+    entry = json.loads(raw)
+    pair = DigestPair(
+        tar_digest=Digest(entry["tar"]),
+        gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, entry["size"],
+                                   Digest(entry["gzip"])))
+    return pair, entry.get("chunks", [])
+
+
+class CacheManager:
+    """Pulls/pushes layers keyed by cache ID through a KV store and a
+    layer transfer backend (registry client or local store)."""
+
+    PUSH_TIMEOUT_SECONDS = 600
+
+    def __init__(self, kv_store, image_store, registry_client=None) -> None:
+        self.kv = kv_store
+        self.store = image_store
+        self.registry = registry_client
+        self._mem: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._pushes: list[threading.Thread] = []
+
+    # -- pull -------------------------------------------------------------
+
+    def pull_cache(self, cache_id: str) -> DigestPair | None:
+        """Layer for this cache ID. Returns None for the EMPTY sentinel (a
+        step known to commit nothing); raises CacheMiss when no usable
+        entry exists. The blob lands in the local store (from the registry
+        if necessary)."""
+        raw = self._mem.get(cache_id)
+        if raw is None:
+            for attempt in range(_KV_RETRIES):
+                try:
+                    raw = self.kv.get(cache_id)
+                    break
+                except Exception as e:  # noqa: BLE001 - network store
+                    log.warning("cache KV get %s failed (try %d): %s",
+                                cache_id, attempt + 1, e)
+            else:
+                raise CacheMiss(cache_id)
+        if raw is None:
+            raise CacheMiss(cache_id)
+        pair, _chunks = decode_entry(raw)
+        if pair is None:
+            # Sentinel: the step is known to produce no layer.
+            return None
+        hex_digest = pair.gzip_descriptor.digest.hex()
+        if not self.store.layers.exists(hex_digest):
+            if self.registry is None:
+                log.info("cache hit %s but layer %s not local; ignoring",
+                         cache_id, hex_digest)
+                raise CacheMiss(cache_id)
+            self.registry.pull_layer(pair.gzip_descriptor.digest)
+        log.info("cache hit %s -> %s", cache_id, hex_digest)
+        return pair
+
+    # -- push -------------------------------------------------------------
+
+    def push_cache(self, cache_id: str,
+                   pair: DigestPair | None,
+                   commit: LayerCommit | None = None) -> None:
+        """Record the mapping and push layer + KV entry asynchronously;
+        failures never fail the build (reference :210-212)."""
+        entry = encode_entry(pair, commit)
+        with self._lock:
+            self._mem[cache_id] = entry
+
+        def push() -> None:
+            try:
+                if pair is not None and self.registry is not None:
+                    self.registry.push_layer(pair.gzip_descriptor.digest)
+                for attempt in range(_KV_RETRIES):
+                    try:
+                        self.kv.put(cache_id, entry)
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("cache KV put %s failed (try %d): %s",
+                                    cache_id, attempt + 1, e)
+            except Exception as e:  # noqa: BLE001
+                log.warning("async cache push %s failed: %s", cache_id, e)
+
+        t = threading.Thread(target=push, daemon=True, name=f"cachepush-{cache_id}")
+        t.start()
+        with self._lock:
+            self._pushes.append(t)
+
+    def wait_for_push(self) -> None:
+        with self._lock:
+            pending, self._pushes = self._pushes, []
+        for t in pending:
+            t.join(timeout=self.PUSH_TIMEOUT_SECONDS)
+            if t.is_alive():
+                log.warning("cache push %s still running at timeout", t.name)
+
+
+class NoopCacheManager:
+    """Cache disabled (reference: noopCacheManager :47-62)."""
+
+    def pull_cache(self, cache_id: str) -> None:
+        raise CacheMiss(cache_id)
+
+    def push_cache(self, cache_id, pair, commit=None) -> None:
+        pass
+
+    def wait_for_push(self) -> None:
+        pass

@@ -1,0 +1,63 @@
+"""BuildContext: shared state threaded through steps of one stage.
+
+Reference: lib/context/build_context.go:35-88. Adds one field the reference
+lacks: the ``hasher`` seam (chunker.Hasher) every committed layer streams
+through — the TPU/CPU backend selection point.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+from makisu_tpu.chunker import CPUHasher, Hasher
+from makisu_tpu.snapshot import MemFS
+from makisu_tpu.storage import ImageStore
+from makisu_tpu.utils import pathutils
+
+_STAGES_DIR = "stages"
+
+
+class BuildContext:
+    def __init__(self, root_dir: str, context_dir: str,
+                 image_store: ImageStore,
+                 hasher: Hasher | None = None,
+                 blacklist: list[str] | None = None,
+                 sync_wait: float | None = None) -> None:
+        self.root_dir = root_dir
+        self.context_dir = context_dir
+        self.image_store = image_store
+        self.stage_vars: dict[str, str] = {}
+        self.copy_ops = []
+        self.must_scan = False
+        self.hasher = hasher or CPUHasher()
+        self.stages_dir = os.path.join(image_store.sandbox_dir, _STAGES_DIR)
+        os.makedirs(self.stages_dir, exist_ok=True)
+        if blacklist is None:
+            blacklist = list(pathutils.DEFAULT_BLACKLIST)
+        self.blacklist = blacklist + [context_dir, image_store.root]
+        kwargs = {} if sync_wait is None else {"sync_wait": sync_wait}
+        self.memfs = MemFS(root_dir, self.blacklist, **kwargs)
+
+    def copy_from_root(self, alias: str) -> str:
+        """Sandbox dir holding stage ``alias``'s checkpointed files for
+        COPY --from (reference: CopyFromRoot build_context.go:83)."""
+        dirname = base64.urlsafe_b64encode(alias.encode()).decode()
+        return os.path.join(self.stages_dir, dirname)
+
+    def new_stage_context(self) -> "BuildContext":
+        """Fresh per-stage context sharing the store and root (the MemFS
+        restarts empty each stage)."""
+        ctx = BuildContext.__new__(BuildContext)
+        ctx.root_dir = self.root_dir
+        ctx.context_dir = self.context_dir
+        ctx.image_store = self.image_store
+        ctx.stage_vars = {}
+        ctx.copy_ops = []
+        ctx.must_scan = False
+        ctx.hasher = self.hasher
+        ctx.stages_dir = self.stages_dir
+        ctx.blacklist = self.blacklist
+        ctx.memfs = MemFS(self.root_dir, self.blacklist,
+                          sync_wait=self.memfs.sync_wait)
+        return ctx

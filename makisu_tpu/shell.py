@@ -1,0 +1,51 @@
+"""Process execution for RUN steps.
+
+Reference: lib/shell/cmd.go (ExecCommand:34 — setpgid, optional
+setuid/setgid from "user[:group]", HOME override, line-streamed output).
+"""
+
+from __future__ import annotations
+
+import os
+import pwd
+import subprocess
+
+from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import sysutils
+
+
+def exec_command(workdir: str, user: str, *argv: str,
+                 env: dict[str, str] | None = None) -> None:
+    """Run argv in ``workdir`` as ``user`` (empty = current), streaming
+    output lines to the logger. Raises CalledProcessError on nonzero exit."""
+    run_env = dict(os.environ if env is None else env)
+    preexec = None
+    if user:
+        uid, gid = sysutils.resolve_chown(user)
+        try:
+            run_env["HOME"] = pwd.getpwuid(uid).pw_dir
+        except KeyError:
+            run_env["HOME"] = "/"
+
+        def preexec() -> None:
+            os.setpgid(0, 0)
+            os.setgid(gid)
+            os.setuid(uid)
+    else:
+        def preexec() -> None:
+            os.setpgid(0, 0)
+
+    proc = subprocess.Popen(
+        argv, cwd=workdir, env=run_env, preexec_fn=preexec,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, bufsize=1)
+    assert proc.stdout is not None and proc.stderr is not None
+    for line in proc.stdout:
+        log.info(line.rstrip("\n"))
+    err_tail = []
+    for line in proc.stderr:
+        err_tail.append(line)
+        log.error(line.rstrip("\n"))
+    code = proc.wait()
+    if code != 0:
+        raise subprocess.CalledProcessError(
+            code, argv, stderr="".join(err_tail[-50:]))

@@ -74,18 +74,23 @@ class CopyOperation:
                 dir_owner=Owner(src_stat.st_uid, src_stat.st_gid, False))
         return fileio.Copier(blacklist)
 
-    def execute(self, eval_symlinks) -> None:
-        """Perform the copy on disk (modifyfs builds). ``eval_symlinks`` is
-        snapshot.walk.eval_symlinks bound by the caller's MemFS root."""
+    def execute(self, eval_symlinks, root: str = "/") -> None:
+        """Perform the copy on disk (modifyfs builds). ``dst`` is logical;
+        ``root`` maps it to the physical build root (identity in
+        production where root is "/"). ``eval_symlinks`` is
+        snapshot.walk.eval_symlinks."""
+        dst = pathutils.join_root(root, self.dst)
+        if is_dir_format(self.dst):
+            dst += "/"
         for src in self.srcs:
             src = eval_symlinks(src, self.src_root)
             src = pathutils.join_root(self.src_root, src)
             st = os.lstat(src)
             copier = self._copier(st)
             if os.path.isdir(src) and not os.path.islink(src):
-                copier.copy_dir(src, self.dst)
+                copier.copy_dir(src, dst)
             elif is_dir_format(self.dst):
-                copier.copy_file(
-                    src, os.path.join(self.dst, os.path.basename(src)))
+                copier.copy_file(src, os.path.join(dst,
+                                                   os.path.basename(src)))
             else:
-                copier.copy_file(src, self.dst)
+                copier.copy_file(src, dst)

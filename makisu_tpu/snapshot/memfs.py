@@ -404,8 +404,9 @@ class MemFS:
             return
         resolved: list[str] = []
         for src in sources:
-            pattern = src if os.path.isabs(src) else os.path.join(
-                self.root, src)
+            # Sources are logical stage paths; map them under the build
+            # root (identity in production where root is "/").
+            pattern = pathutils.join_root(self.root, src)
             matches = glob(pattern)
             resolved.extend(matches or [pattern])
         for src in resolved:

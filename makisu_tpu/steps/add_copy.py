@@ -1,0 +1,121 @@
+"""ADD/COPY steps: content-addressed cache IDs and copy operations.
+
+Reference: lib/builder/step/add_copy_step.go (cache ID over walked file
+contents SetCacheID:102, glob resolution resolveFromPaths:171, Execute
+:126-150 building snapshot.CopyOperation) and add_step.go (ADD is COPY
+without --from; the reference implements no URL/auto-extract support).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from glob import glob
+
+from makisu_tpu.context import BuildContext
+from makisu_tpu.snapshot import CopyOperation, eval_symlinks
+from makisu_tpu.steps.base import BuildStep
+from makisu_tpu.utils import pathutils, sysutils
+
+
+class AddCopyStep(BuildStep):
+    def __init__(self, directive: str, args: str, chown: str,
+                 from_stage: str, srcs: list[str], dst: str,
+                 commit: bool, preserve_owner: bool) -> None:
+        super().__init__(args, commit)
+        self.directive = directive
+        self.chown = chown
+        self.from_stage = from_stage
+        self.srcs = [s.strip("\"'") for s in srcs]
+        self.dst = dst.strip("\"'")
+        self.preserve_owner = preserve_owner
+        if len(self.srcs) > 1 and not (
+                self.dst.endswith("/") or self.dst in (".", "..")):
+            raise ValueError(
+                'copying multiple sources: destination must end with "/"')
+
+    def require_on_disk(self) -> bool:
+        return bool(self.chown)
+
+    def context_dirs(self) -> tuple[str, list[str]]:
+        if not self.from_stage:
+            return "", []
+        return self.from_stage, list(self.srcs)
+
+    def _source_root(self, ctx: BuildContext) -> str:
+        if self.from_stage:
+            return ctx.copy_from_root(self.from_stage)
+        return ctx.context_dir
+
+    def _resolve_sources(self, ctx: BuildContext) -> list[str]:
+        """Glob-expand sources against the source root (absolute paths)."""
+        root = self._source_root(ctx)
+        out: list[str] = []
+        for src in self.srcs:
+            pattern = os.path.join(root, pathutils.rel_path(src))
+            matches = glob(pattern)
+            out.extend(sorted(matches) if matches else [pattern])
+        return out
+
+    def set_cache_id(self, ctx: BuildContext, seed: str) -> None:
+        """Content-addressed: the cache ID covers the bytes being copied,
+        so a context change invalidates exactly the right steps."""
+        checksum = zlib.crc32(
+            (seed + self.directive + self.args).encode())
+        if not self.from_stage:
+            # Cross-stage copies rely on chained stage cache IDs instead.
+            for source in self._resolve_sources(ctx):
+                checksum = self._checksum_tree(ctx, source, checksum)
+        self.cache_id = format(checksum & 0xFFFFFFFF, "x")
+
+    def _checksum_tree(self, ctx: BuildContext, path: str,
+                       checksum: int) -> int:
+        if not os.path.lexists(path):
+            return checksum
+        st = os.lstat(path)
+        if sysutils.is_special_file(st):
+            return checksum
+        rel = os.path.relpath(path, ctx.context_dir)
+        checksum = zlib.crc32(rel.encode(), checksum)
+        if os.path.islink(path):
+            return zlib.crc32(os.readlink(path).encode(), checksum)
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                checksum = self._checksum_tree(
+                    ctx, os.path.join(path, name), checksum)
+            return checksum
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    return checksum
+                checksum = zlib.crc32(chunk, checksum)
+
+    def execute(self, ctx: BuildContext, modify_fs: bool) -> None:
+        source_root = self._source_root(ctx)
+        rel_paths = [pathutils.trim_root(s, source_root)
+                     for s in self._resolve_sources(ctx)]
+        blacklist = list(pathutils.DEFAULT_BLACKLIST) + [ctx.image_store.root]
+        op = CopyOperation(
+            rel_paths, source_root, self.logical_working_dir, self.dst,
+            chown=self.chown, blacklist=blacklist,
+            internal=bool(self.from_stage),
+            preserve_owner=self.preserve_owner)
+        ctx.copy_ops.append(op)
+        if modify_fs:
+            op.execute(eval_symlinks, ctx.root_dir)
+
+
+class AddStep(AddCopyStep):
+    def __init__(self, args: str, chown: str, srcs: list[str], dst: str,
+                 commit: bool, preserve_owner: bool) -> None:
+        super().__init__("ADD", args, chown, "", srcs, dst, commit,
+                         preserve_owner)
+
+
+class CopyStep(AddCopyStep):
+    def __init__(self, args: str, chown: str, from_stage: str,
+                 srcs: list[str], dst: str, commit: bool,
+                 preserve_owner: bool) -> None:
+        super().__init__("COPY", args, chown, from_stage, srcs, dst, commit,
+                         preserve_owner)

@@ -1,0 +1,96 @@
+"""FROM step: establish the base image.
+
+Reference: lib/builder/step/from_step.go (Execute:94-137 applies base
+layers to MemFS; Commit:139 returns the base DigestPairs when the stage is
+copied-from; UpdateCtxAndConfig seeds config + stage vars from the base).
+"""
+
+from __future__ import annotations
+
+from makisu_tpu import tario
+from makisu_tpu.context import BuildContext
+from makisu_tpu.docker.image import (
+    Digest,
+    DigestPair,
+    DistributionManifest,
+    ImageConfig,
+    ImageName,
+)
+from makisu_tpu.steps.base import BuildStep, chain_cache_id
+from makisu_tpu.utils import logging as log
+
+
+class FromStep(BuildStep):
+    directive = "FROM"
+
+    def __init__(self, args: str, image: str, alias: str) -> None:
+        super().__init__(args, commit=False)
+        if image.lower() != "scratch":
+            image = str(ImageName.parse_for_pull(image))
+        self.image = image
+        self.alias = alias
+        self.registry_client = None  # injected by the plan
+        self._manifest: DistributionManifest | None = None
+        self._config: ImageConfig | None = None
+
+    @property
+    def is_scratch(self) -> bool:
+        return self.image.lower() == "scratch"
+
+    def set_cache_id(self, ctx: BuildContext, seed: str) -> None:
+        self.cache_id = chain_cache_id(seed, self.directive, self.image)
+
+    def _load(self, ctx: BuildContext) -> None:
+        if self._manifest is not None:
+            return
+        name = ImageName.parse(self.image)
+        store = ctx.image_store
+        if store.manifests.exists(name):
+            manifest = store.manifests.load(name)
+        else:
+            if self.registry_client is None:
+                raise RuntimeError(
+                    f"no registry client to pull base image {self.image}")
+            manifest = self.registry_client.pull(name)
+        config_blob = store.layers.open(manifest.config.digest.hex()).read()
+        self._manifest = manifest
+        self._config = ImageConfig.from_bytes(config_blob)
+        if len(self._config.rootfs.diff_ids) != len(manifest.layers):
+            raise ValueError(
+                "base image layer count mismatch between config and manifest")
+
+    def execute(self, ctx: BuildContext, modify_fs: bool) -> None:
+        if self.is_scratch:
+            log.info("scratch base image; nothing to apply")
+            return
+        self._load(ctx)
+        assert self._manifest is not None
+        for descriptor in self._manifest.layers:
+            log.info("applying FROM layer %s", descriptor.digest.hex())
+            with ctx.image_store.layers.open(descriptor.digest.hex()) as f:
+                with tario.gzip_reader(f) as gz:
+                    import tarfile
+                    with tarfile.open(fileobj=gz, mode="r|") as tf:
+                        ctx.memfs.update_from_tar(tf, untar=modify_fs)
+
+    def commit(self, ctx: BuildContext) -> list[DigestPair]:
+        if self.is_scratch:
+            return []
+        self._load(ctx)
+        assert self._manifest is not None and self._config is not None
+        return [
+            DigestPair(Digest(diff_id), desc)
+            for diff_id, desc in zip(self._config.rootfs.diff_ids,
+                                     self._manifest.layers)
+        ]
+
+    def update_ctx_and_config(self, ctx: BuildContext,
+                              config: ImageConfig | None) -> ImageConfig:
+        if self.is_scratch:
+            return ImageConfig()
+        self._load(ctx)
+        assert self._config is not None
+        for kv in self._config.config.env:
+            key, _, val = kv.partition("=")
+            ctx.stage_vars[key] = val
+        return self._config.clone()

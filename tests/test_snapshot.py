@@ -324,8 +324,7 @@ def test_copyop_execute_on_disk(tmp_path):
     root.mkdir()
     ctx = _ctx(tmp_path)
     op = CopyOperation(["sub"], str(ctx), "/", "/app/")
-    op.dst = str(root) + "/app/"  # execute() works on physical paths
-    op.execute(eval_symlinks)
+    op.execute(eval_symlinks, str(root))
     assert (root / "app" / "f2").read_text() == "two"
 
 
