@@ -78,6 +78,9 @@ class BuildPlan:
                         remote_image=dep_alias)
                     self.stages.append(shadow)
                     seed = shadow.seed_out
+                    # One shadow per image, even when several stages copy
+                    # from it.
+                    aliases.add(dep_alias)
             self.stages.append(stage)
             seed = stage.seed_out
         if self.stage_target and self.stage_target not in aliases:

@@ -52,7 +52,8 @@ class FromStep(BuildStep):
                 raise RuntimeError(
                     f"no registry client to pull base image {self.image}")
             manifest = self.registry_client.pull(name)
-        config_blob = store.layers.open(manifest.config.digest.hex()).read()
+        with store.layers.open(manifest.config.digest.hex()) as f:
+            config_blob = f.read()
         self._manifest = manifest
         self._config = ImageConfig.from_bytes(config_blob)
         if len(self._config.rootfs.diff_ids) != len(manifest.layers):

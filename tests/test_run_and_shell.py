@@ -1,0 +1,86 @@
+"""RUN-step and shell-exec tests (safe in-tree: commands write relative
+to the tmp build root via cwd, never absolute host paths)."""
+
+import subprocess
+
+import pytest
+
+from makisu_tpu import shell
+from makisu_tpu.builder import BuildPlan
+from makisu_tpu.cache import NoopCacheManager
+from makisu_tpu.context import BuildContext
+from makisu_tpu.docker.image import ImageName
+from makisu_tpu.dockerfile import parse_file
+from makisu_tpu.storage import ImageStore
+from makisu_tpu.utils import mountinfo
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)
+
+
+def test_exec_command_streams_and_succeeds(tmp_path):
+    shell.exec_command(str(tmp_path), "", "sh", "-c", "echo ok > out.txt")
+    assert (tmp_path / "out.txt").read_text() == "ok\n"
+
+
+def test_exec_command_failure_carries_stderr(tmp_path):
+    with pytest.raises(subprocess.CalledProcessError) as exc:
+        shell.exec_command(str(tmp_path), "", "sh", "-c",
+                           "echo boom >&2; exit 3")
+    assert exc.value.returncode == 3
+    assert "boom" in exc.value.stderr
+
+
+def test_exec_command_large_stderr_no_deadlock(tmp_path):
+    # >64KB on both pipes: sequential draining would deadlock.
+    shell.exec_command(
+        str(tmp_path), "", "sh", "-c",
+        "i=0; while [ $i -lt 3000 ]; do echo 'line of output'; "
+        "echo 'error line goes here' >&2; i=$((i+1)); done")
+
+
+def test_run_step_creates_scanned_layer(tmp_path):
+    root = tmp_path / "root"
+    root.mkdir()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    stages = parse_file(
+        "FROM scratch\nRUN echo generated > produced.txt\n")
+    plan = BuildPlan(ctx, ImageName("", "t/run", "latest"), [],
+                     NoopCacheManager(), stages, allow_modify_fs=True,
+                     force_commit=False)
+    manifest = plan.execute()
+    import gzip
+    import io
+    import tarfile
+    members = {}
+    for desc in manifest.layers:
+        with store.layers.open(desc.digest.hex()) as f:
+            data = gzip.decompress(f.read())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+            for m in tf:
+                members[m.name] = (m, tf.extractfile(m).read()
+                                   if m.isreg() and m.size else b"")
+    assert "produced.txt" in members
+    assert members["produced.txt"][1] == b"generated\n"
+
+
+def test_run_without_modifyfs_fails(tmp_path):
+    root = tmp_path / "root"
+    root.mkdir()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    stages = parse_file("FROM scratch\nRUN echo hi\n")
+    plan = BuildPlan(ctx, ImageName("", "t/run", "latest"), [],
+                     NoopCacheManager(), stages, allow_modify_fs=False,
+                     force_commit=False)
+    with pytest.raises(RuntimeError):
+        plan.execute()
