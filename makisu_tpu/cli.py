@@ -1,15 +1,23 @@
 """makisu-tpu command line: build / pull / push / diff / version.
 
-Reference surface: bin/makisu/cmd/ (root.go:73-87). Subcommands are filled
-in as their subsystems land; ``version`` is always available.
+Reference surface: bin/makisu/cmd/ (root.go:73-87; build flags
+build.go:97-135; helpers utils.go:41-224; pull.go, push.go, diff.go,
+version.go). One addition over the reference: ``--hasher cpu|tpu``
+selects the layer-commit hashing backend (the TPU path also records
+chunk fingerprints into the distributed cache).
 """
 
 from __future__ import annotations
 
 import argparse
+import cProfile
+import os
 import sys
 
 import makisu_tpu
+from makisu_tpu import tario
+from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import pathutils
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -18,21 +26,321 @@ def make_parser() -> argparse.ArgumentParser:
         description="TPU-native daemonless container image builder.")
     parser.add_argument("--log-level", default="info",
                         choices=["debug", "info", "warn", "error"])
+    parser.add_argument("--log-output", default="stdout")
     parser.add_argument("--log-fmt", default="json",
                         choices=["json", "console"])
+    parser.add_argument("--cpu-profile", action="store_true",
+                        help="write a cProfile dump to /tmp/makisu-tpu.prof")
     sub = parser.add_subparsers(dest="command")
+
+    build = sub.add_parser("build", help="build a docker image")
+    build.add_argument("context", help="build context directory")
+    build.add_argument("-t", "--tag", required=True,
+                       help="image tag (repo:tag)")
+    build.add_argument("-f", "--file", default="",
+                       help="Dockerfile path (default <context>/Dockerfile)")
+    build.add_argument("--push", action="append", default=[],
+                       metavar="REGISTRY",
+                       help="push the built image to this registry")
+    build.add_argument("--replica", action="append", default=[],
+                       help="additional tags to save/push")
+    build.add_argument("--registry-config", default="",
+                       help="registry config file or inline JSON")
+    build.add_argument("--dest", default="",
+                       help="write a docker-save tar here")
+    build.add_argument("--target", default="",
+                       help="build up to this stage only")
+    build.add_argument("--build-arg", action="append", default=[],
+                       metavar="K=V")
+    build.add_argument("--modifyfs", action="store_true",
+                       help="allow modifying the local filesystem")
+    build.add_argument("--commit", default="implicit",
+                       choices=["implicit", "explicit"],
+                       help="layer commit policy (#!COMMIT honored in "
+                            "explicit mode)")
+    build.add_argument("--blacklist", action="append", default=[],
+                       help="extra paths to exclude from layers")
+    build.add_argument("--local-cache-ttl", default="168h")
+    build.add_argument("--redis-cache-addr", default="")
+    build.add_argument("--redis-cache-password", default="")
+    build.add_argument("--http-cache-addr", default="")
+    build.add_argument("--http-cache-header", action="append", default=[])
+    build.add_argument("--docker-host",
+                       default=os.environ.get("DOCKER_HOST",
+                                              "unix:///var/run/docker.sock"))
+    build.add_argument("--docker-version",
+                       default=os.environ.get("DOCKER_VERSION", "1.21"))
+    build.add_argument("--load", action="store_true",
+                       help="load the image into the local docker daemon")
+    build.add_argument("--storage", default="",
+                       help="storage directory (default /makisu-storage or "
+                            "$HOME fallback)")
+    build.add_argument("--compression", default="default",
+                       choices=sorted(tario.COMPRESSION_LEVELS))
+    build.add_argument("--preserve-root", action="store_true",
+                       help="save and restore / around the build")
+    build.add_argument("--root", default="/",
+                       help="build filesystem root (testing)")
+    build.add_argument("--hasher", default="cpu", choices=["cpu", "tpu"],
+                       help="layer hashing backend; tpu adds CDC chunk "
+                            "fingerprints for chunk-granular caching")
+
+    pull = sub.add_parser("pull", help="pull an image into the store")
+    pull.add_argument("image")
+    pull.add_argument("--extract", default="",
+                      help="untar the pulled rootfs into this directory")
+    pull.add_argument("--storage", default="")
+    pull.add_argument("--registry-config", default="")
+
+    push = sub.add_parser("push", help="push an image tar to registries")
+    push.add_argument("tar_path")
+    push.add_argument("-t", "--tag", required=True)
+    push.add_argument("--push", action="append", default=[],
+                      metavar="REGISTRY", dest="registries")
+    push.add_argument("--storage", default="")
+    push.add_argument("--registry-config", default="")
+
+    diff = sub.add_parser("diff", help="compare two images")
+    diff.add_argument("images", nargs=2)
+    diff.add_argument("--ignore-modtime", action="store_true")
+    diff.add_argument("--storage", default="")
+    diff.add_argument("--registry-config", default="")
+
     sub.add_parser("version", help="print the build version")
     return parser
+
+
+def _storage_dir(flag: str) -> str:
+    if flag:
+        return flag
+    if os.path.isdir(os.path.dirname(pathutils.DEFAULT_STORAGE_DIR) or "/") \
+            and os.access("/", os.W_OK):
+        return pathutils.DEFAULT_STORAGE_DIR
+    return os.path.join(os.path.expanduser("~"), ".makisu-tpu-storage")
+
+
+def _parse_build_args(pairs: list[str]) -> dict[str, str]:
+    out = {}
+    for pair in pairs:
+        key, sep, val = pair.partition("=")
+        if not sep:
+            val = os.environ.get(key, "")
+        out[key] = val
+    return out
+
+
+def _new_cache_manager(args, store):
+    from makisu_tpu.cache import (
+        CacheManager,
+        FSStore,
+        HTTPStore,
+        MemoryStore,
+        RedisStore,
+    )
+    from makisu_tpu.dockerfile import parse_duration
+    ttl = parse_duration(args.local_cache_ttl) / 1e9
+    if args.redis_cache_addr:
+        kv = RedisStore(args.redis_cache_addr, ttl,
+                        args.redis_cache_password)
+    elif args.http_cache_addr:
+        headers = dict(h.split(":", 1) for h in args.http_cache_header)
+        kv = HTTPStore(args.http_cache_addr, headers)
+    elif args.local_cache_ttl in ("0", "0s"):
+        return None
+    else:
+        kv = FSStore(os.path.join(store.root,
+                                  pathutils.CACHE_KV_FILE_NAME), ttl)
+    return CacheManager(kv, store)
+
+
+def cmd_build(args) -> int:
+    from makisu_tpu.builder import BuildPlan
+    from makisu_tpu.cache import NoopCacheManager
+    from makisu_tpu.chunker import get_hasher
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.dockerfile import parse_file
+    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.storage import ImageStore
+
+    if args.registry_config:
+        update_global_config(args.registry_config)
+    tario.set_compression(args.compression)
+    for extra in args.blacklist:
+        if extra not in pathutils.DEFAULT_BLACKLIST:
+            pathutils.DEFAULT_BLACKLIST.append(extra)
+
+    dockerfile_path = args.file or os.path.join(args.context, "Dockerfile")
+    with open(dockerfile_path) as f:
+        stages = parse_file(f.read(), _parse_build_args(args.build_arg))
+
+    target = ImageName.parse(args.tag)
+    replicas = [ImageName.parse(r) for r in args.replica]
+
+    with ImageStore(_storage_dir(args.storage)) as store:
+        ctx = BuildContext(args.root, os.path.abspath(args.context), store,
+                           hasher=get_hasher(args.hasher))
+        cache_mgr = _new_cache_manager(args, store) or NoopCacheManager()
+        preserver = None
+        if args.preserve_root and args.modifyfs:
+            from makisu_tpu.storage.root_preserver import RootPreserver
+            preserver = RootPreserver(args.root, store.sandbox_dir,
+                                      ctx.blacklist)
+        try:
+            plan = BuildPlan(ctx, target, replicas, cache_mgr, stages,
+                             allow_modify_fs=args.modifyfs,
+                             force_commit=(args.commit == "implicit"),
+                             stage_target=args.target,
+                             registry_client=_FromPuller(store))
+            manifest = plan.execute()
+        finally:
+            if preserver is not None:
+                preserver.restore()
+        log.info("successfully built image %s", target)
+
+        for registry in args.push:
+            name = target.with_registry(registry)
+            client = new_client(store, name)
+            client.push(name if name.registry else target)
+            for replica in replicas:
+                new_client(store, replica.with_registry(registry)).push(
+                    replica.with_registry(registry))
+            log.info("successfully pushed %s to %s", name, registry)
+        if args.dest:
+            from makisu_tpu.docker.save import write_save_tar
+            write_save_tar(store, target, args.dest)
+            log.info("saved image tar to %s", args.dest)
+        if args.load:
+            from makisu_tpu.docker.daemon import DockerClient
+            from makisu_tpu.docker.save import write_save_tar
+            tar_path = os.path.join(store.sandbox_dir, "load.tar")
+            write_save_tar(store, target, tar_path)
+            DockerClient(args.docker_host,
+                         args.docker_version).image_tar_load(tar_path)
+            log.info("loaded image into docker daemon")
+    log.info("finished building %s", target)
+    return 0
+
+
+class _FromPuller:
+    """Registry access for FROM steps: resolves a client per image name
+    and saves manifests under the image's own name."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+
+    def pull(self, name):
+        from makisu_tpu.registry import new_client
+        return new_client(self.store, name).pull(name)
+
+
+def cmd_pull(args) -> int:
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.storage import ImageStore
+
+    if args.registry_config:
+        update_global_config(args.registry_config)
+    name = ImageName.parse_for_pull(args.image)
+    with ImageStore(_storage_dir(args.storage)) as store:
+        manifest = new_client(store, name).pull(name)
+        log.info("pulled %s (%d layers)", name, len(manifest.layers))
+        if args.extract:
+            from makisu_tpu.snapshot import MemFS
+            os.makedirs(args.extract, exist_ok=True)
+            fs = MemFS(args.extract, blacklist=[])
+            for desc in manifest.layers:
+                fs.update_from_tar_path(store.layers.path(desc.digest.hex()),
+                                        untar=True)
+            log.info("extracted rootfs to %s", args.extract)
+    return 0
+
+
+def cmd_push(args) -> int:
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.docker.save import load_save_tar
+    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.storage import ImageStore
+
+    if args.registry_config:
+        update_global_config(args.registry_config)
+    name = ImageName.parse(args.tag)
+    with ImageStore(_storage_dir(args.storage)) as store:
+        load_save_tar(store, args.tar_path, name)
+        for registry in args.registries or [name.registry]:
+            if not registry:
+                raise SystemExit("no registry to push to (use --push)")
+            target = name.with_registry(registry)
+            store.manifests.save(target, store.manifests.load(name))
+            new_client(store, target).push(target)
+            log.info("pushed %s", target)
+    return 0
+
+
+def cmd_diff(args) -> int:
+    import tempfile
+
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.snapshot import MemFS
+    from makisu_tpu.storage import ImageStore
+
+    if args.registry_config:
+        update_global_config(args.registry_config)
+    with ImageStore(_storage_dir(args.storage)) as store:
+        trees = []
+        for image in args.images:
+            name = ImageName.parse_for_pull(image)
+            manifest = new_client(store, name).pull(name)
+            root = tempfile.mkdtemp(dir=store.sandbox_dir)
+            fs = MemFS(root, blacklist=[])
+            for desc in manifest.layers:
+                fs.update_from_tar_path(
+                    store.layers.path(desc.digest.hex()), untar=False)
+            trees.append(fs)
+        diff = trees[0].compare(trees[1],
+                                ignore_mtime=args.ignore_modtime)
+        for p in diff.missing_in_first:
+            print(f"only in {args.images[1]}: {p}")
+        for p in diff.missing_in_second:
+            print(f"only in {args.images[0]}: {p}")
+        for p, h1, h2 in diff.different:
+            print(f"differs: {p} "
+                  f"[{h1.mode:o} {h1.uid}:{h1.gid} {h1.size}] vs "
+                  f"[{h2.mode:o} {h2.uid}:{h2.gid} {h2.size}]")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
+    log.configure(args.log_level.replace("warn", "warning"), args.log_fmt,
+                  args.log_output)
     if args.command == "version":
         print(makisu_tpu.BUILD_HASH)
         return 0
-    parser.print_help()
-    return 1
+    handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
+                "diff": cmd_diff}
+    handler = handlers.get(args.command)
+    if handler is None:
+        parser.print_help()
+        return 1
+    profiler = None
+    if args.cpu_profile:
+        profiler = cProfile.Profile()
+        profiler.enable()
+    try:
+        return handler(args)
+    except Exception as e:  # noqa: BLE001 - top-level CLI boundary
+        log.error("failed to execute command: %s", e)
+        if args.log_level == "debug":
+            raise
+        return 1
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats("/tmp/makisu-tpu.prof")
+            log.info("cpu profile written to /tmp/makisu-tpu.prof")
 
 
 if __name__ == "__main__":

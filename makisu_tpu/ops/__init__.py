@@ -13,4 +13,19 @@ work is re-designed data-parallel for the TPU VPU:
   2^32), computed in 5 log-doubling steps — fully parallel over positions.
 """
 
-from makisu_tpu.ops import gear, sha256  # noqa: F401
+import os as _os
+
+import jax as _jax
+
+# Environments that preload jax at interpreter start (sitecustomize PJRT
+# hooks) snapshot config before JAX_PLATFORMS from the caller's env can
+# take effect, which can send CPU-only builds to a hardware backend (and
+# hang on its tunnel). Re-assert the env var through jax.config, which is
+# honored until backends initialize.
+if "JAX_PLATFORMS" in _os.environ:
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # noqa: BLE001 - backends already initialized
+        pass
+
+from makisu_tpu.ops import gear, sha256  # noqa: E402,F401

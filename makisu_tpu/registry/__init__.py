@@ -1,0 +1,17 @@
+"""Registry v2 client (reference: lib/registry/)."""
+
+from makisu_tpu.registry.client import RegistryClient, new_client
+from makisu_tpu.registry.config import (
+    RegistryConfig,
+    SecurityConfig,
+    config_for,
+    reset_global_config,
+    update_global_config,
+)
+from makisu_tpu.registry.fixtures import RegistryFixture, make_test_image
+
+__all__ = [
+    "RegistryClient", "RegistryConfig", "RegistryFixture", "SecurityConfig",
+    "config_for", "make_test_image", "new_client", "reset_global_config",
+    "update_global_config",
+]

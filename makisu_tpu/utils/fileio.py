@@ -23,6 +23,11 @@ class Owner:
     overwrite: bool  # force this owner instead of the source's
 
 
+def _copy_times(src: str, dst: str) -> None:
+    st = os.lstat(src)
+    os.utime(dst, ns=(st.st_atime_ns, st.st_mtime_ns))
+
+
 def _chown(path: str, uid: int, gid: int, follow_symlinks=True) -> None:
     try:
         os.chown(path, uid, gid, follow_symlinks=follow_symlinks)
@@ -58,6 +63,7 @@ class Copier:
         self._mkdir_ancestors(os.path.dirname(dst))
         self._ensure_dir(src, dst, top=True)
         self._copy_dir_contents(src, dst, dst)
+        _copy_times(src, dst)
 
     # -- internals --------------------------------------------------------
 
@@ -97,6 +103,8 @@ class Copier:
             if os.path.isdir(cur_src) and not os.path.islink(cur_src):
                 self._ensure_dir(cur_src, cur_dst, top=False)
                 self._copy_dir_contents(cur_src, cur_dst, orig_dst)
+                # Post-order so child writes don't clobber the dir mtime.
+                _copy_times(cur_src, cur_dst)
             else:
                 self._copy_file(cur_src, cur_dst)
 
@@ -120,6 +128,10 @@ class Copier:
             uid, gid = self.file_owner.uid, self.file_owner.gid
         _chown(dst, uid, gid)
         os.chmod(dst, st.st_mode & 0o7777)
+        # Preserve mtime: the snapshot layer records the source's header,
+        # so the on-disk copy must look identical or the next scan-diff
+        # re-adds every copied file.
+        os.utime(dst, ns=(st.st_atime_ns, st.st_mtime_ns))
 
 
 def reader_to_file(reader, dst: str) -> int:

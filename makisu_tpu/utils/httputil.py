@@ -1,0 +1,127 @@
+"""HTTP plumbing for the registry/cache clients.
+
+Reference capability: lib/utils/httputil/ (option-pattern Send:286 with
+accepted-status checking, retry/backoff on 408/5xx and network errors,
+TLS client config, https→http fallback :403-421, NetworkError
+classification).
+
+The ``Transport`` seam is what makes the registry client hermetically
+testable: the real transport speaks urllib; fixtures replay canned
+responses in-process (reference: mocks/net/http + registry fixtures).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ssl
+import time
+import urllib.error
+import urllib.request
+from typing import BinaryIO
+
+RETRYABLE_CODES = {408, 502, 503, 504}
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, url: str, body: bytes = b"") -> None:
+        super().__init__(f"HTTP {status} for {url}: {body[:200]!r}")
+        self.status = status
+        self.url = url
+        self.body = body
+
+
+class NetworkError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Response:
+    status: int
+    headers: dict[str, str]
+    body: bytes
+
+    def header(self, name: str) -> str:
+        return self.headers.get(name.lower(), "")
+
+
+class Transport:
+    """Performs one HTTP exchange. Bodies are fully materialized; layer
+    blobs stream via chunked PATCH uploads so each exchange stays
+    bounded."""
+
+    def __init__(self, tls_verify: bool = True,
+                 ca_cert: str | None = None,
+                 client_cert: tuple[str, str] | None = None) -> None:
+        self.tls_verify = tls_verify
+        self.ca_cert = ca_cert
+        self.client_cert = client_cert
+
+    def _ssl_context(self) -> ssl.SSLContext:
+        ctx = ssl.create_default_context(cafile=self.ca_cert)
+        if not self.tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.client_cert:
+            ctx.load_cert_chain(*self.client_cert)
+        return ctx
+
+    def round_trip(self, method: str, url: str, headers: dict[str, str],
+                   body: bytes | BinaryIO | None = None,
+                   timeout: float = 60.0) -> Response:
+        if hasattr(body, "read"):
+            body = body.read()
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPSHandler(context=self._ssl_context()),
+            _NoRedirect())
+        try:
+            with opener.open(req, timeout=timeout) as resp:
+                return Response(resp.status,
+                                {k.lower(): v for k, v in resp.headers.items()},
+                                resp.read())
+        except urllib.error.HTTPError as e:
+            data = e.read() if hasattr(e, "read") else b""
+            return Response(e.code,
+                            {k.lower(): v for k, v in e.headers.items()},
+                            data)
+        except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+            raise NetworkError(f"{method} {url}: {e}") from e
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    """Registry clients must see 3xx themselves (upload Location flows)."""
+
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
+def send(transport: Transport, method: str, url: str,
+         headers: dict[str, str] | None = None,
+         body: bytes | None = None,
+         accepted: tuple[int, ...] = (200,),
+         retries: int = 3, backoff: float = 0.5,
+         timeout: float = 60.0,
+         allow_http_fallback: bool = False) -> Response:
+    """One request with retry/backoff on retryable statuses and network
+    errors, optional https→http downgrade for plain-HTTP registries."""
+    headers = dict(headers or {})
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            resp = transport.round_trip(method, url, headers, body, timeout)
+        except NetworkError as e:
+            last = e
+            if allow_http_fallback and url.startswith("https://"):
+                url = "http://" + url[len("https://"):]
+                continue
+            time.sleep(backoff * (2 ** attempt))
+            continue
+        if resp.status in accepted:
+            return resp
+        if resp.status in RETRYABLE_CODES and attempt < retries - 1:
+            time.sleep(backoff * (2 ** attempt))
+            continue
+        raise HTTPError(resp.status, url, resp.body)
+    assert last is not None
+    raise last

@@ -20,6 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# makisu_tpu.ops re-asserts JAX_PLATFORMS from the env (so the CLI works
+# outside pytest); keep the env consistent with the config override.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 # Reuse compiled executables across test processes.
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")

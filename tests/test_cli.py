@@ -1,0 +1,79 @@
+"""CLI end-to-end: build a context through the real entry point."""
+
+import io
+import json
+import subprocess
+import sys
+import tarfile
+
+import pytest
+
+from makisu_tpu import cli
+from makisu_tpu.utils import mountinfo
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)
+
+
+@pytest.fixture
+def context(tmp_path):
+    ctx = tmp_path / "ctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\n"
+        "COPY greeting.txt /etc/greeting\n"
+        'ENTRYPOINT ["/bin/app"]\n')
+    (ctx / "greeting.txt").write_text("hello from makisu-tpu\n")
+    return ctx
+
+
+def test_version():
+    assert cli.main(["version"]) == 0
+
+
+def test_build_to_dest(tmp_path, context):
+    root = tmp_path / "root"
+    root.mkdir()
+    dest = tmp_path / "image.tar"
+    rc = cli.main([
+        "--log-fmt", "console", "build", str(context),
+        "-t", "demo/app:latest",
+        "--storage", str(tmp_path / "storage"),
+        "--root", str(root),
+        "--dest", str(dest),
+    ])
+    assert rc == 0
+    with tarfile.open(dest) as tf:
+        names = tf.getnames()
+        export = json.load(tf.extractfile("manifest.json"))
+    assert export[0]["RepoTags"] == ["demo/app:latest"]
+    assert any(n.endswith("layer.tar") for n in names)
+    # The layer holds the copied file.
+    with tarfile.open(dest) as tf:
+        layer_name = export[0]["Layers"][0]
+        inner = tarfile.open(fileobj=io.BytesIO(
+            tf.extractfile(layer_name).read()))
+        members = {m.name for m in inner}
+    assert "etc/greeting" in members
+
+
+def test_build_missing_dockerfile_fails(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = cli.main(["build", str(empty), "-t", "x:y",
+                   "--storage", str(tmp_path / "s"),
+                   "--root", str(tmp_path / "r")])
+    assert rc == 1
+
+
+def test_cli_subprocess_entrypoint(tmp_path, context):
+    """The module runs as python -m makisu_tpu.cli (console-script path)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "makisu_tpu.cli", "version"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert out.stdout.strip()
