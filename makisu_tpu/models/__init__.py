@@ -1,0 +1,5 @@
+"""Flagship "model": the snapshot-hash pipeline as a jittable unit."""
+
+from makisu_tpu.models.snapshot_hasher import SnapshotHasher
+
+__all__ = ["SnapshotHasher"]

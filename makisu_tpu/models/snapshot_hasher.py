@@ -1,0 +1,53 @@
+"""SnapshotHasher: the accelerator program at the heart of the framework.
+
+This is the "flagship model" in ML-framework terms: a fixed-shape,
+jittable computation that consumes a batch of layer-stream blocks and a
+batch of chunk lanes and produces (candidate-boundary bitmaps, chunk
+digests). Single-chip it runs as plain jit; multi-chip it shards over a
+(data, seq) mesh with a Gear-window halo exchange (parallel/pipeline.py).
+
+Reference counterpart being replaced: the sequential CPU hash loop at
+lib/builder/step/common.go:35-67.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from makisu_tpu.ops import gear, sha256
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotHasher:
+    """Configuration: chunking geometry + batch shapes."""
+
+    avg_bits: int = gear.DEFAULT_AVG_BITS
+    block_bytes: int = 1 << 20      # per-stream block shipped to the chip
+    batch: int = 8                  # streams scanned per step
+    lanes: int = 1024               # chunk lanes hashed per step
+    lane_cap: int = 16 * 1024       # bytes per lane buffer
+
+    def example_inputs(self) -> tuple[jax.Array, jax.Array, jax.Array]:
+        blocks = jnp.zeros((self.batch, self.block_bytes), jnp.uint8)
+        lanes = jnp.zeros((self.lanes, self.lane_cap), jnp.uint8)
+        lengths = jnp.full((self.lanes,), 64, jnp.int32)
+        return blocks, lanes, lengths
+
+    def forward(self, blocks: jax.Array, lanes: jax.Array,
+                lengths: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """One hash step: gear candidate bitmaps + per-lane digests."""
+        bitmap = gear.pack_bits(
+            gear.boundary_mask(gear.gear_hash(blocks), self.avg_bits))
+        digests = sha256.sha256_lanes(lanes, lengths)
+        return bitmap, digests
+
+    def jit_forward(self):
+        return jax.jit(self.forward)
+
+    def sharded_step(self, mesh):
+        """The multi-chip step over a (data, seq) mesh."""
+        from makisu_tpu.parallel.pipeline import snapshot_hash_step
+        return snapshot_hash_step(mesh, self.avg_bits)

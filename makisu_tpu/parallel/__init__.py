@@ -1,0 +1,23 @@
+"""Multi-chip parallelism for the hashing pipeline (mesh + shardings +
+halo-stitched kernels)."""
+
+from makisu_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    block_sharding,
+    lane_sharding,
+    lane_vec_sharding,
+    make_mesh,
+    replicated,
+)
+from makisu_tpu.parallel.pipeline import (
+    gear_bitmap_sharded,
+    sha256_lanes_sharded,
+    snapshot_hash_step,
+)
+
+__all__ = [
+    "DATA_AXIS", "SEQ_AXIS", "block_sharding", "lane_sharding",
+    "lane_vec_sharding", "make_mesh", "replicated",
+    "gear_bitmap_sharded", "sha256_lanes_sharded", "snapshot_hash_step",
+]

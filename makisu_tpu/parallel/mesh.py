@@ -1,0 +1,61 @@
+"""Device-mesh helpers for the hashing pipeline.
+
+The mesh has two axes:
+- ``data``: independent byte buffers / lane groups (pure data parallel).
+- ``seq``: the long-stream dimension of one buffer, sharded with a
+  Gear-window halo exchanged over ICI (parallel/pipeline.py) — this
+  system's sequence-parallel axis (SURVEY.md §5).
+
+Multi-host scale-out follows the same recipe: jax.distributed initializes
+processes, the mesh spans all devices, and XLA routes the halo ppermute
+over ICI/DCN. No hand-rolled communication anywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(devices=None, seq_parallel: int | None = None) -> Mesh:
+    """Build a (data, seq) mesh over the available devices.
+
+    ``seq_parallel`` fixes the seq-axis size; by default the mesh is
+    as square as possible with seq >= data (halo traffic is cheap, so
+    favor splitting the long dimension).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if seq_parallel is None:
+        seq_parallel = 1
+        for cand in range(int(np.sqrt(n)), n + 1):
+            if n % cand == 0:
+                seq_parallel = cand
+                break
+    if n % seq_parallel:
+        raise ValueError(f"{n} devices not divisible by seq={seq_parallel}")
+    arr = np.array(devices).reshape(n // seq_parallel, seq_parallel)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, N] byte blocks: batch over data, stream over seq."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS, SEQ_AXIS))
+
+
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    """[L, CAP] chunk lanes: lanes over every device."""
+    return NamedSharding(mesh, PartitionSpec((DATA_AXIS, SEQ_AXIS), None))
+
+
+def lane_vec_sharding(mesh: Mesh) -> NamedSharding:
+    """[L] per-lane scalars, matching lane_sharding's first axis."""
+    return NamedSharding(mesh, PartitionSpec((DATA_AXIS, SEQ_AXIS)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,104 @@
+"""Mesh-sharded hashing pipeline: the multi-chip form of ops/gear +
+ops/sha256.
+
+The long-stream dimension is genuinely sequence-parallel: Gear's hash at
+position i depends on at most the 31 previous bytes (mod 2^32 window), so
+a shard only needs a WINDOW-byte halo from its left neighbor —
+one ``lax.ppermute`` over ICI per scan, the cheapest possible collective.
+This is the project's ring-attention analogue (SURVEY.md §5): where the
+reference hashes a layer as one sequential CPU stream
+(lib/builder/step/common.go:35-67), here the stream splits across chips
+with exact boundary stitching.
+
+Chunk-lane SHA-256 is embarrassingly parallel over lanes; sharding the
+lane axis over the whole mesh needs no collectives at all.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from makisu_tpu.ops import gear, sha256
+from makisu_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+
+def _gear_local(block: jax.Array, axis_name: str) -> jax.Array:
+    """Per-shard gear hashes with a left-neighbor halo over ``axis_name``.
+
+    block: uint8 [..., n_local]; returns uint32 [..., n_local].
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    halo = jax.lax.ppermute(
+        block[..., -gear.WINDOW:], axis_name,
+        perm=[(i, (i + 1) % n_shards) for i in range(n_shards)])
+    ext = jnp.concatenate([halo, block], axis=-1)
+    h_with_halo = gear.gear_hash(ext)[..., gear.WINDOW:]
+    # Shard 0 has no left history: its hashes must treat the stream as
+    # starting at its first byte (zero history != zero-valued halo bytes).
+    h_start = gear.gear_hash(block)
+    is_first = jax.lax.axis_index(axis_name) == 0
+    return jnp.where(is_first, h_start, h_with_halo)
+
+
+def gear_bitmap_sharded(mesh: Mesh, avg_bits: int = gear.DEFAULT_AVG_BITS):
+    """Jitted [B, N] uint8 → [B, N//32] uint32 candidate bitmap, with B
+    over the data axis and N over the seq axis (halo-stitched)."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=P(DATA_AXIS, SEQ_AXIS),
+        out_specs=P(DATA_AXIS, SEQ_AXIS))
+    def _shard(block):
+        h = _gear_local(block, SEQ_AXIS)
+        return gear.pack_bits(gear.boundary_mask(h, avg_bits))
+
+    return jax.jit(_shard)
+
+
+def sha256_lanes_sharded(mesh: Mesh):
+    """Jitted ragged-lane SHA-256 with lanes spread over every device."""
+    lanes_spec = P((DATA_AXIS, SEQ_AXIS), None)
+    vec_spec = P((DATA_AXIS, SEQ_AXIS))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(lanes_spec, vec_spec),
+        out_specs=P((DATA_AXIS, SEQ_AXIS), None))
+    def _shard(data, lengths):
+        msg = sha256.pad_lanes(data, lengths)
+        # The scan carry must be device-varying like the data (shard_map
+        # typing); mark the constant IV accordingly.
+        state0 = jnp.broadcast_to(jnp.asarray(sha256._H0)[:, None],
+                                  (8, data.shape[0]))
+        state0 = jax.lax.pcast(state0, (DATA_AXIS, SEQ_AXIS), to="varying")
+        return sha256.sha256_words(sha256.bytes_to_words(msg),
+                                   sha256.num_blocks(lengths),
+                                   init_state=state0)
+
+    return jax.jit(_shard)
+
+
+def snapshot_hash_step(mesh: Mesh, avg_bits: int = gear.DEFAULT_AVG_BITS):
+    """The full sharded "step": gear-scan a batch of stream blocks AND
+    hash a batch of chunk lanes in one compiled program.
+
+    blocks:  uint8 [B, N]    (B % data-axis == 0, N % (32*seq-axis) == 0)
+    lanes:   uint8 [L, CAP]  (L % device-count == 0, CAP % 64 == 0)
+    lengths: int32 [L]
+    Returns (bitmap uint32 [B, N//32], digests uint32 [L, 8]).
+    """
+    gear_fn = gear_bitmap_sharded(mesh, avg_bits)
+    sha_fn = sha256_lanes_sharded(mesh)
+
+    def step(blocks, lanes, lengths):
+        return gear_fn(blocks), sha_fn(lanes, lengths)
+
+    return jax.jit(step)
