@@ -1,0 +1,12 @@
+"""Long-lived worker mode: build over a unix socket.
+
+Reference: lib/client/ (MakisuClient {Ready, Build, Exit} over a unix
+socket, client.go:36-191). The reference ships only the client; here the
+worker server is included too, so CI systems can keep one warm process
+(with its JAX kernels compiled) and feed it builds.
+"""
+
+from makisu_tpu.worker.client import WorkerClient
+from makisu_tpu.worker.server import WorkerServer
+
+__all__ = ["WorkerClient", "WorkerServer"]

@@ -1,0 +1,108 @@
+"""Worker client: submit builds to a long-lived worker.
+
+Reference: lib/client/client.go (MakisuClient{Ready,Build,Exit}:36-61,
+context copy into the shared mount prepareContext:141, log streaming +
+build_code extraction readLines:160-191).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+
+from makisu_tpu.utils import fileio
+from makisu_tpu.utils import logging as log
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, path: str, timeout: float) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._path = path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._path)
+        self.sock = sock
+
+
+class WorkerClient:
+    def __init__(self, socket_path: str,
+                 local_shared_path: str = "",
+                 worker_shared_path: str = "",
+                 timeout: float = 3600.0) -> None:
+        self.socket_path = socket_path
+        self.local_shared_path = local_shared_path
+        self.worker_shared_path = worker_shared_path
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: bytes | None = None):
+        conn = _UnixHTTPConnection(self.socket_path, self.timeout)
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"}
+                     if body else {})
+        return conn, conn.getresponse()
+
+    def ready(self) -> bool:
+        try:
+            conn, resp = self._request("GET", "/ready")
+            try:
+                return resp.status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+
+    def exit(self) -> None:
+        conn, resp = self._request("GET", "/exit")
+        conn.close()
+
+    def prepare_context(self, context_dir: str) -> str:
+        """Copy the build context into the shared mount and return the
+        path the worker sees (reference: prepareContext:141)."""
+        if not self.local_shared_path:
+            return context_dir
+        name = os.path.basename(os.path.normpath(context_dir)) or "context"
+        local_dst = os.path.join(self.local_shared_path, name)
+        fileio.Copier([]).copy_dir(context_dir, local_dst)
+        return os.path.join(self.worker_shared_path or
+                            self.local_shared_path, name)
+
+    def build(self, argv: list[str],
+              context_dir: str | None = None) -> int:
+        """Submit a build; stream log lines to the local logger; return
+        the worker's build exit code."""
+        if context_dir is not None:
+            worker_ctx = self.prepare_context(context_dir)
+            argv = list(argv) + [worker_ctx]
+        conn, resp = self._request("POST", "/build",
+                                   json.dumps(argv).encode())
+        build_code = 1
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /build returned {resp.status}")
+            buf = b""
+            while True:
+                chunk = resp.read(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    try:
+                        payload = json.loads(line)
+                    except ValueError:
+                        log.info(line.decode(errors="replace"))
+                        continue
+                    if "build_code" in payload:
+                        build_code = int(payload["build_code"])
+                    else:
+                        log.info("[worker] %s", payload.get("msg", line))
+        finally:
+            conn.close()
+        return build_code

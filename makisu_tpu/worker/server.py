@@ -1,0 +1,121 @@
+"""Worker server: accept build requests over a unix socket.
+
+Protocol (reference: lib/client/client.go):
+- GET  /ready  → 200 when accepting builds
+- POST /build  → body is a JSON argv list for the build command; the
+  response streams newline-delimited JSON log lines and ends with
+  ``{"build_code": "<exit code>"}``
+- GET  /exit   → 200, then the server shuts down
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def do_GET(self) -> None:
+        if self.path == "/ready":
+            self._respond(200, b"ok")
+        elif self.path == "/exit":
+            self._respond(200, b"bye")
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+        else:
+            self._respond(404, b"not found")
+
+    def do_POST(self) -> None:
+        if self.path != "/build":
+            self._respond(404, b"not found")
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            argv = json.loads(self.rfile.read(length))
+        except ValueError:
+            self._respond(400, b"bad argv json")
+            return
+        self.send_response(200)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(line: str) -> None:
+            data = (line.rstrip("\n") + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+
+        code = self.server.run_build(argv, emit)
+        emit(json.dumps({"build_code": str(code)}))
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _respond(self, status: int, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, socket_path: str) -> None:
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        super().__init__(socket_path, _Handler)
+        self.socket_path = socket_path
+
+    # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
+    # wants a (host, port) tuple for logging.
+    def get_request(self):
+        request, _ = super().get_request()
+        return request, ("worker", 0)
+
+    def run_build(self, argv: list[str], emit) -> int:
+        """Run one build command in-process, forwarding log lines."""
+        import logging
+
+        from makisu_tpu import cli
+        from makisu_tpu.utils.logging import get_logger
+
+        class _EmitHandler(logging.Handler):
+            def __init__(self) -> None:
+                super().__init__()
+                self.setFormatter(logging.Formatter("%(message)s"))
+
+            def handle(self_inner, record) -> None:
+                try:
+                    emit(json.dumps({
+                        "level": record.levelname.lower(),
+                        "msg": record.getMessage(),
+                    }))
+                except OSError:
+                    pass  # client went away; keep building
+
+        handler = _EmitHandler()
+        logger = get_logger()
+        logger.addHandler(handler)
+        try:
+            return cli.main(argv)
+        except SystemExit as e:
+            return int(e.code or 0)
+        except Exception as e:  # noqa: BLE001 - worker must survive
+            emit(json.dumps({"level": "error", "msg": str(e)}))
+            return 1
+        finally:
+            logger.removeHandler(handler)
+
+    def serve_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t

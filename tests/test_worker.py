@@ -1,0 +1,72 @@
+"""Worker-mode tests: build over the unix socket, end to end."""
+
+import pytest
+
+from makisu_tpu.utils import mountinfo
+from makisu_tpu.worker import WorkerClient, WorkerServer
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)
+
+
+@pytest.fixture
+def worker(tmp_path):
+    server = WorkerServer(str(tmp_path / "worker.sock"))
+    thread = server.serve_background()
+    yield server
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def test_ready(worker):
+    client = WorkerClient(worker.socket_path)
+    assert client.ready()
+
+
+def test_not_ready_when_absent(tmp_path):
+    assert not WorkerClient(str(tmp_path / "nope.sock")).ready()
+
+
+def test_build_through_worker(tmp_path, worker):
+    ctx = tmp_path / "ctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY data.txt /data.txt\n")
+    (ctx / "data.txt").write_text("payload")
+    (tmp_path / "root").mkdir()
+    client = WorkerClient(worker.socket_path)
+    code = client.build([
+        "build", str(ctx), "-t", "worker/test:1",
+        "--storage", str(tmp_path / "storage"),
+        "--root", str(tmp_path / "root"),
+        "--dest", str(tmp_path / "out.tar"),
+    ])
+    assert code == 0
+    assert (tmp_path / "out.tar").exists()
+
+
+def test_build_failure_code(tmp_path, worker):
+    client = WorkerClient(worker.socket_path)
+    code = client.build(["build", "/nonexistent-ctx", "-t", "x:y",
+                         "--storage", str(tmp_path / "s"),
+                         "--root", str(tmp_path / "r")])
+    assert code == 1
+
+
+def test_prepare_context_copies_into_shared(tmp_path, worker):
+    shared = tmp_path / "shared"
+    shared.mkdir()
+    ctx = tmp_path / "myctx"
+    ctx.mkdir()
+    (ctx / "f").write_text("x")
+    client = WorkerClient(worker.socket_path,
+                          local_shared_path=str(shared),
+                          worker_shared_path="/mnt/shared")
+    worker_path = client.prepare_context(str(ctx))
+    assert worker_path == "/mnt/shared/myctx"
+    assert (shared / "myctx" / "f").read_text() == "x"
