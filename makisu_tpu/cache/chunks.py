@@ -1,0 +1,154 @@
+"""Chunk-granular layer dedup.
+
+The reference's cache maps one cache ID to one whole layer blob
+(lib/cache/cache_manager.go:39-40): any content change re-transfers the
+entire layer. Here every cache entry written by the TPU hasher also
+carries the layer's content-defined chunk list (offset, length, sha256 of
+the uncompressed tar stream). Because the gzip writer is deterministic
+(tario.gzip_writer pins mtime/filename/level), a layer blob is a pure
+function of its chunk bytes — so a builder that misses the layer blob but
+holds the chunks (from *any* earlier layer that shared them) rebuilds the
+blob locally, byte-identical, transferring only novel chunks.
+
+Chunk blobs live in a CAS keyed by chunk digest; remote distribution
+rides the same registry blob plane the layer cache already uses.
+"""
+
+from __future__ import annotations
+
+import gzip as gzip_mod
+import hashlib
+import io
+import os
+
+from makisu_tpu import tario
+from makisu_tpu.docker.image import Digest, DigestPair
+from makisu_tpu.storage.cas import CASStore
+from makisu_tpu.utils import logging as log
+
+
+class ChunkStore:
+    """CAS of uncompressed-stream chunks, keyed by hex sha256."""
+
+    def __init__(self, root: str, max_entries: int = 65536) -> None:
+        self.cas = CASStore(root, max_entries)
+
+    def has(self, hex_digest: str) -> bool:
+        return self.cas.exists(hex_digest)
+
+    def get(self, hex_digest: str) -> bytes:
+        with self.cas.open(hex_digest) as f:
+            return f.read()
+
+    def put(self, hex_digest: str, data: bytes) -> None:
+        if hashlib.sha256(data).hexdigest() != hex_digest:
+            raise ValueError(f"chunk content does not match {hex_digest}")
+        self.cas.write_bytes(hex_digest, data)
+
+    def index_layer(self, layer_blob_path: str,
+                    chunks: list[tuple[int, int, str]]) -> int:
+        """Slice a layer's uncompressed stream into its chunks and store
+        any that are new. Returns the number of chunks added."""
+        with open(layer_blob_path, "rb") as f:
+            stream = gzip_mod.decompress(f.read())
+        added = 0
+        for offset, length, hex_digest in chunks:
+            if self.has(hex_digest):
+                continue
+            self.put(hex_digest, stream[offset:offset + length])
+            added += 1
+        return added
+
+    def coverage(self, chunks: list[tuple[int, int, str]]) -> float:
+        """Fraction of the layer's bytes already present as chunks."""
+        total = sum(length for _, length, _ in chunks)
+        if total == 0:
+            return 1.0
+        have = sum(length for _, length, hex_digest in chunks
+                   if self.has(hex_digest))
+        return have / total
+
+    def reconstitute(self, pair: DigestPair,
+                     chunks: list[tuple[int, int, str]]) -> bytes | None:
+        """Rebuild a layer blob from chunks; verify both digests.
+        Returns None if any chunk is missing."""
+        parts: list[bytes] = []
+        pos = 0
+        for offset, length, hex_digest in chunks:
+            if offset != pos or not self.has(hex_digest):
+                if offset != pos:
+                    log.warning("chunk list has a gap at %d (expected %d)",
+                                offset, pos)
+                return None
+            parts.append(self.get(hex_digest))
+            pos = offset + length
+        stream = b"".join(parts)
+        if Digest.of_bytes(stream) != pair.tar_digest:
+            log.warning("reconstituted stream digest mismatch for %s",
+                        pair.tar_digest)
+            return None
+        out = io.BytesIO()
+        with tario.gzip_writer(out) as gz:
+            gz.write(stream)
+        blob = out.getvalue()
+        if Digest.of_bytes(blob) != pair.gzip_descriptor.digest:
+            # Different compression level/implementation produced the
+            # original blob; the bytes are right but the registry identity
+            # isn't. Refuse rather than corrupt the CAS.
+            log.warning("reconstituted gzip digest mismatch for %s "
+                        "(compression settings differ?)",
+                        pair.gzip_descriptor.digest)
+            return None
+        return blob
+
+
+def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
+    """Wire a ChunkStore into a CacheManager: index chunks on push,
+    reconstitute layers on pull when the blob is missing locally."""
+    chunk_store = ChunkStore(chunk_root)
+    inner_push = manager.push_cache
+    inner_pull = manager.pull_cache
+
+    def push_cache(cache_id, pair, commit=None):
+        inner_push(cache_id, pair, commit)
+        if pair is not None and commit is not None and commit.chunks:
+            try:
+                path = manager.store.layers.path(
+                    pair.gzip_descriptor.digest.hex())
+                added = chunk_store.index_layer(
+                    path, [(c.offset, c.length, c.hex_digest)
+                           for c in commit.chunks])
+                log.info("indexed %d new chunks for %s", added, cache_id)
+            except FileNotFoundError:
+                pass
+
+    def pull_cache(cache_id):
+        from makisu_tpu.cache.manager import CacheMiss, decode_entry
+        try:
+            return inner_pull(cache_id)
+        except CacheMiss:
+            raw = manager._mem.get(cache_id)
+            if raw is None:
+                try:
+                    raw = manager.kv.get(cache_id)
+                except Exception:  # noqa: BLE001
+                    raw = None
+            if raw is None:
+                raise
+            pair, chunks = decode_entry(raw)
+            if pair is None or not chunks:
+                raise
+            blob = chunk_store.reconstitute(
+                pair, [tuple(c) for c in chunks])
+            if blob is None:
+                raise
+            manager.store.layers.write_bytes(
+                pair.gzip_descriptor.digest.hex(), blob)
+            log.info("reconstituted layer %s from %d cached chunks",
+                     pair.gzip_descriptor.digest.hex(), len(chunks))
+            return pair
+
+    manager.push_cache = push_cache
+    manager.pull_cache = pull_cache
+    manager.chunk_store = chunk_store
+    return chunk_store

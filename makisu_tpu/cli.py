@@ -181,6 +181,10 @@ def cmd_build(args) -> int:
         ctx = BuildContext(args.root, os.path.abspath(args.context), store,
                            hasher=get_hasher(args.hasher))
         cache_mgr = _new_cache_manager(args, store) or NoopCacheManager()
+        if args.hasher == "tpu" and not isinstance(cache_mgr,
+                                                   NoopCacheManager):
+            from makisu_tpu.cache.chunks import attach_chunk_dedup
+            attach_chunk_dedup(cache_mgr, os.path.join(store.root, "chunks"))
         preserver = None
         if args.preserve_root and args.modifyfs:
             from makisu_tpu.storage.root_preserver import RootPreserver

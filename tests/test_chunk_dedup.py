@@ -1,0 +1,125 @@
+"""Chunk-granular cache dedup tests: the headline capability the
+reference lacks (whole-layer cache only)."""
+
+import json
+
+import pytest
+
+from makisu_tpu.builder import BuildPlan
+from makisu_tpu.cache import CacheManager, MemoryStore
+from makisu_tpu.cache.chunks import ChunkStore, attach_chunk_dedup
+from makisu_tpu.chunker import TPUHasher
+from makisu_tpu.context import BuildContext
+from makisu_tpu.docker.image import ImageName
+from makisu_tpu.dockerfile import parse_file
+from makisu_tpu.storage import ImageStore
+from makisu_tpu.utils import mountinfo
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)
+
+
+def build(tmp_path, tag, kv, chunk_root, store_name, payload: bytes):
+    """One builder instance with its own layer store but shared KV and
+    shared chunk store (simulating two machines + distributed planes)."""
+    ctx_dir = tmp_path / f"ctx-{tag}"
+    if not ctx_dir.exists():
+        ctx_dir.mkdir()
+        (ctx_dir / "blob.bin").write_bytes(payload)
+    root = tmp_path / f"root-{tag}"
+    root.mkdir(exist_ok=True)
+    store = ImageStore(str(tmp_path / store_name))
+    ctx = BuildContext(str(root), str(ctx_dir), store,
+                       hasher=TPUHasher(), sync_wait=0.0)
+    mgr = CacheManager(kv, store)
+    attach_chunk_dedup(mgr, str(chunk_root))
+    stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+    plan = BuildPlan(ctx, ImageName("", "t/dedup", tag), [], mgr, stages,
+                     allow_modify_fs=False, force_commit=True)
+    manifest = plan.execute()
+    mgr.wait_for_push()
+    return manifest, store, mgr
+
+
+def test_layer_reconstitution_across_builders(tmp_path):
+    import numpy as np
+    payload = np.random.default_rng(0).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    chunk_root = tmp_path / "chunks"
+
+    # Builder A: populates KV + chunk store.
+    manifest_a, store_a, _ = build(tmp_path, "a", kv, chunk_root,
+                                   "store-a", payload)
+    # Builder B: fresh layer store, same KV + chunks, same context bytes.
+    # Its cache pull must reconstitute the layer without the blob.
+    ctx_dir = tmp_path / "ctx-a"  # same content → same cache IDs
+    root = tmp_path / "root-b"
+    root.mkdir()
+    store_b = ImageStore(str(tmp_path / "store-b"))
+    ctx = BuildContext(str(root), str(ctx_dir), store_b,
+                       hasher=TPUHasher(), sync_wait=0.0)
+    mgr = CacheManager(kv, store_b)
+    attach_chunk_dedup(mgr, str(chunk_root))
+    stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+    plan = BuildPlan(ctx, ImageName("", "t/dedup", "b"), [], mgr, stages,
+                     allow_modify_fs=False, force_commit=True)
+    manifest_b = plan.execute()
+    assert [str(l.digest) for l in manifest_a.layers] == \
+        [str(l.digest) for l in manifest_b.layers]
+    # The blob exists in B's store now, rebuilt from chunks.
+    assert store_b.layers.exists(manifest_b.layers[0].digest.hex())
+
+
+def test_chunk_coverage_after_small_edit(tmp_path):
+    """Insert bytes near the front of a large file: most chunk bytes must
+    be reusable (the >=3x warm-hit-rate story vs whole-layer caching)."""
+    import numpy as np
+    payload = np.random.default_rng(1).integers(
+        0, 256, size=400_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    chunk_root = tmp_path / "chunks"
+    build(tmp_path, "a", kv, chunk_root, "store-1", payload)
+
+    edited = payload[:500] + b"EDIT" + payload[500:]
+    _, _, mgr = build(tmp_path, "edited", kv, chunk_root, "store-2",
+                      edited)
+    entries = [json.loads(v) for v in kv._data.values()
+               if v != "MAKISU_TPU_CACHE_EMPTY"]
+    chunked = [e for e in entries if "chunks" in e]
+    assert chunked
+    # Whole-layer dedup would reuse 0 bytes (layer digest changed);
+    # chunk coverage of the edited layer should be mostly reusable.
+    store = ChunkStore(str(chunk_root))
+    best = max(store.coverage([tuple(c) for c in e["chunks"]])
+               for e in chunked)
+    assert best > 0.5
+
+
+def test_reconstitute_refuses_missing_chunk(tmp_path):
+    import hashlib
+
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_LAYER,
+        Descriptor,
+        Digest,
+        DigestPair,
+    )
+    store = ChunkStore(str(tmp_path / "chunks"))
+    data = b"x" * 1000
+    store.put(hashlib.sha256(data).hexdigest(), data)
+    pair = DigestPair(Digest.of_bytes(data * 2),
+                      Descriptor(MEDIA_TYPE_LAYER, 0, Digest.of_bytes(b"")))
+    chunks = [(0, 1000, hashlib.sha256(data).hexdigest()),
+              (1000, 1000, "ab" * 32)]  # second chunk missing
+    assert store.reconstitute(pair, chunks) is None
+
+
+def test_chunk_put_verifies_digest(tmp_path):
+    store = ChunkStore(str(tmp_path / "chunks"))
+    with pytest.raises(ValueError):
+        store.put("00" * 32, b"not matching")
