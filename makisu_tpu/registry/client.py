@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import re
 import threading
 import time
@@ -85,15 +86,48 @@ class RegistryClient:
             scheme = "http"
         return f"{scheme}://{host}/v2/{self.repository}"
 
+    def _basic_credentials(self) -> tuple[str, str] | None:
+        sec = self.config.security
+        if sec.basic_user:
+            return sec.basic_user, sec.basic_password
+        if sec.cred_helper:
+            return self._exec_cred_helper(sec.cred_helper)
+        return None
+
+    def _exec_cred_helper(self, helper: str) -> tuple[str, str] | None:
+        """docker-credential-<helper> get (reference: security.go:128,
+        helpers under /makisu-internal/, :39)."""
+        import shutil
+        import subprocess
+        binary = None
+        for cand in (f"/makisu-internal/docker-credential-{helper}",
+                     f"docker-credential-{helper}"):
+            binary = cand if os.path.isfile(cand) else shutil.which(cand)
+            if binary:
+                break
+        if not binary:
+            log.warning("credential helper %s not found", helper)
+            return None
+        try:
+            out = subprocess.run(
+                [binary, "get"], input=self.registry.encode(),
+                capture_output=True, timeout=30, check=True)
+            payload = json.loads(out.stdout)
+            return payload.get("Username", ""), payload.get("Secret", "")
+        except (OSError, ValueError, subprocess.SubprocessError) as e:
+            log.warning("credential helper %s failed: %s", helper, e)
+            return None
+
     def _headers(self, extra: dict[str, str] | None = None) -> dict[str, str]:
         headers = dict(extra or {})
-        sec = self.config.security
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        elif sec.basic_user:
-            cred = base64.b64encode(
-                f"{sec.basic_user}:{sec.basic_password}".encode()).decode()
-            headers["Authorization"] = f"Basic {cred}"
+        else:
+            creds = self._basic_credentials()
+            if creds is not None:
+                cred = base64.b64encode(
+                    f"{creds[0]}:{creds[1]}".encode()).decode()
+                headers["Authorization"] = f"Basic {cred}"
         return headers
 
     def _send(self, method: str, url: str,
@@ -117,10 +151,8 @@ class RegistryClient:
     def _authenticate(self, err: HTTPError) -> bool:
         """Bearer-token dance from a WWW-Authenticate challenge
         (reference: security/basicauth.go:41-89)."""
-        resp_headers = getattr(err, "headers", None)
-        challenge = None
-        # The 401 body/headers come back through HTTPError; re-probe the
-        # endpoint to read the challenge header.
+        # The 401 came back through HTTPError; re-probe the endpoint to
+        # read the challenge header.
         probe = self.transport.round_trip(
             "GET", err.url, self._headers({}), None, self.config.timeout)
         challenge = probe.header("www-authenticate")
@@ -137,10 +169,10 @@ class RegistryClient:
             query.append(f"scope={params['scope']}")
         url = realm + ("?" + "&".join(query) if query else "")
         headers = {}
-        sec = self.config.security
-        if sec.basic_user:
+        creds = self._basic_credentials()
+        if creds is not None:
             cred = base64.b64encode(
-                f"{sec.basic_user}:{sec.basic_password}".encode()).decode()
+                f"{creds[0]}:{creds[1]}".encode()).decode()
             headers["Authorization"] = f"Basic {cred}"
         resp = send(self.transport, "GET", url, headers, accepted=(200,),
                     retries=self.config.retries, timeout=self.config.timeout)

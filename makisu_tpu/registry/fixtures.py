@@ -46,6 +46,8 @@ def make_test_image(files: dict[str, bytes] | None = None,
     tar_bytes = tar_buf.getvalue()
     layer_blob = gzip.compress(tar_bytes, mtime=0)
     config = ImageConfig()
+    if isinstance(env, str):
+        raise TypeError("env must be a list of KEY=VAL strings")
     config.config.env = env or []
     config.rootfs.diff_ids = [str(Digest.of_bytes(tar_bytes))]
     config_blob = config.to_bytes()
@@ -65,7 +67,7 @@ class RegistryFixture(Transport):
     """In-process registry: blobs/manifests in dicts, full upload state
     machine, per-(method,url-regex) response overrides."""
 
-    def __init__(self) -> None:
+    def __init__(self, require_token: str = "") -> None:
         super().__init__()
         self.blobs: dict[str, bytes] = {}          # hex → blob
         self.manifests: dict[str, bytes] = {}      # "<repo>:<tag>" → json
@@ -73,6 +75,9 @@ class RegistryFixture(Transport):
         self.overrides: list[tuple[str, str, Response]] = []
         self.requests: list[tuple[str, str]] = []  # log for assertions
         self._next_upload = 0
+        # When set, /v2/ endpoints demand "Bearer <require_token>" and
+        # 401-challenge to /token (exercises the auth dance).
+        self.require_token = require_token
 
     # -- test wiring ------------------------------------------------------
 
@@ -97,6 +102,17 @@ class RegistryFixture(Transport):
         if hasattr(body, "read"):
             body = body.read()
         path = re.sub(r"^https?://[^/]+", "", url)
+
+        if path.startswith("/token"):
+            return Response(200, {}, json.dumps(
+                {"token": self.require_token}).encode())
+        if self.require_token and path.startswith("/v2/"):
+            if headers.get("Authorization") != f"Bearer {self.require_token}":
+                return Response(401, {
+                    "www-authenticate":
+                        'Bearer realm="https://registry.test/token",'
+                        'service="registry.test",scope="repo:pull"',
+                }, b"authentication required")
 
         m = re.fullmatch(r"/v2/(.+)/manifests/([^/]+)", path)
         if m:

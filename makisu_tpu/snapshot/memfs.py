@@ -200,7 +200,11 @@ class MemFS:
             self._apply_entry(layer.add_header(src, dst, hdr))
         if create_whiteouts and hdr.isdir() and node is not None:
             for child in list(node.children.values()):
-                if not child.is_on_disk():
+                # Existence is judged at the child's logical path under
+                # the build root — not entry.src, which for copy-op
+                # entries points at the (still-existing) context file.
+                disk = pathutils.join_root(self.root, child.dst)
+                if not os.path.lexists(disk):
                     self._add_ancestors(layer, child.dst, inclusive=False)
                     entry = layer.add_whiteout(child.dst)
                     self._apply_entry(entry)

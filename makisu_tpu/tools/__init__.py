@@ -1,0 +1,1 @@
+"""Standalone tools (reference: tools/bin/mkrootfs)."""

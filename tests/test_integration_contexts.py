@@ -1,0 +1,234 @@
+"""Integration contexts mirroring the reference's testdata suite
+(test/python/test_build.py over testdata/build-context/: simple, symlink,
+copy-glob, copy-from, chown, arg-and-env, global-arg, target,
+preserve-root, from-base-image...). Hermetic: registry fixture instead of
+a registry container, tmp build roots instead of /.
+"""
+
+import gzip
+import io
+import json
+import os
+import tarfile
+
+import pytest
+
+from makisu_tpu.builder import BuildPlan
+from makisu_tpu.cache import NoopCacheManager
+from makisu_tpu.context import BuildContext
+from makisu_tpu.docker.image import ImageConfig, ImageName
+from makisu_tpu.dockerfile import parse_file
+from makisu_tpu.registry import (
+    RegistryClient,
+    RegistryFixture,
+    make_test_image,
+)
+from makisu_tpu.storage import ImageStore
+from makisu_tpu.utils import mountinfo
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)
+
+
+class Env:
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.ctx_dir = tmp_path / "ctx"
+        self.ctx_dir.mkdir()
+        self.root = tmp_path / "root"
+        self.root.mkdir()
+        self.store = ImageStore(str(tmp_path / "store"))
+        self.fixture = RegistryFixture()
+
+    def file(self, rel, content="x", mode=None):
+        p = self.ctx_dir / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+        if mode is not None:
+            os.chmod(p, mode)
+        return p
+
+    def serve_base(self, repo="library/base", tag="latest", **kw):
+        manifest, config_blob, blobs = make_test_image(**kw)
+        self.fixture.serve_image(repo, tag, manifest, blobs)
+        return manifest
+
+    def build(self, dockerfile, *, tag="t/int:1", modify_fs=False,
+              build_args=None, target="", force_commit=False):
+        env = self
+
+        class Puller:
+            def pull(self, name):
+                client = RegistryClient(env.store, name.registry,
+                                        name.repository,
+                                        transport=env.fixture)
+                return client.pull(name)
+
+        ctx = BuildContext(str(self.root), str(self.ctx_dir), self.store,
+                           sync_wait=0.0)
+        plan = BuildPlan(ctx, ImageName.parse(tag), [], NoopCacheManager(),
+                         parse_file(dockerfile, build_args),
+                         allow_modify_fs=modify_fs,
+                         force_commit=force_commit, stage_target=target,
+                         registry_client=Puller())
+        return plan.execute()
+
+    def layers(self, manifest):
+        members = {}
+        for desc in manifest.layers:
+            with self.store.layers.open(desc.digest.hex()) as f:
+                data = gzip.decompress(f.read())
+            with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+                for m in tf:
+                    members[m.name] = m
+        return members
+
+    def config(self, manifest) -> ImageConfig:
+        with self.store.layers.open(manifest.config.digest.hex()) as f:
+            return ImageConfig.from_json(json.load(f))
+
+
+@pytest.fixture
+def env(tmp_path):
+    return Env(tmp_path)
+
+
+def test_context_simple(env):
+    env.file("hello.txt", "hello")
+    m = env.build("FROM scratch\nCOPY hello.txt /hello.txt\n"
+                  'CMD ["cat", "/hello.txt"]\n')
+    assert "hello.txt" in env.layers(m)
+    assert env.config(m).config.cmd == ["cat", "/hello.txt"]
+
+
+def test_context_symlink(env):
+    env.file("real.txt", "data")
+    os.symlink("real.txt", env.ctx_dir / "link.txt")
+    m = env.build("FROM scratch\nCOPY . /app/\n")
+    members = env.layers(m)
+    assert members["app/link.txt"].issym()
+    assert members["app/link.txt"].linkname == "real.txt"
+
+
+def test_context_copy_glob(env):
+    env.file("a.txt", "a")
+    env.file("b.txt", "b")
+    env.file("c.md", "c")
+    m = env.build("FROM scratch\nCOPY *.txt /texts/\n")
+    members = env.layers(m)
+    assert "texts/a.txt" in members and "texts/b.txt" in members
+    assert "texts/c.md" not in members
+
+
+def test_context_chown(env):
+    env.file("owned.txt", "o")
+    m = env.build("FROM scratch\nCOPY --chown=503:503 owned.txt /data/\n",
+                  modify_fs=True)
+    members = env.layers(m)
+    assert members["data/owned.txt"].uid == 503
+    assert members["data/owned.txt"].gid == 503
+
+
+def test_context_arg_and_env(env):
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch\n"
+        "ARG build_ver=0.1\n"
+        "ENV APP_VERSION=$build_ver\n"
+        "LABEL ver=${APP_VERSION}\n",
+        build_args={"build_ver": "9.9"})
+    cfg = env.config(m)
+    assert "APP_VERSION=9.9" in cfg.config.env
+    assert cfg.config.labels == {"ver": "9.9"}
+
+
+def test_context_global_arg(env):
+    env.serve_base("library/alpine", "3.9")
+    m = env.build(
+        "ARG IMG=alpine:3.9\nFROM $IMG\nLABEL done=1\n")
+    assert env.config(m).config.labels == {"done": "1"}
+
+
+def test_context_target(env):
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch AS one\nLABEL stage=one\n"
+        "FROM scratch AS two\nLABEL stage=two\n", target="one")
+    assert env.config(m).config.labels == {"stage": "one"}
+
+
+def test_from_base_image_layers_and_env(env):
+    base = env.serve_base(env=["PATH=/usr/bin:/bin"])
+    env.file("app.bin", "binary")
+    m = env.build("FROM index.docker.io/library/base\n"
+                  "COPY app.bin /usr/local/bin/app\n"
+                  "ENV EXTRA=$PATH\n")
+    # Base layer is first, new layer appended.
+    assert [str(l.digest) for l in m.layers[:1]] == \
+        [str(l.digest) for l in base.layers]
+    cfg = env.config(m)
+    assert len(cfg.rootfs.diff_ids) == 2
+    assert "EXTRA=/usr/bin:/bin" in cfg.config.env  # base env visible
+    members = env.layers(m)
+    assert "etc/base-release" in members           # base content merged
+    assert "usr/local/bin/app" in members
+
+
+def test_from_base_with_modifyfs_untars(env):
+    env.serve_base()
+    env.file("x", "x")
+    env.build("FROM index.docker.io/library/base\nRUN test -f etc/base-release\n",
+              modify_fs=True)
+    # RUN's `test -f` exited 0 (the build would have failed otherwise):
+    # the base rootfs was materialized on disk for the RUN step. The
+    # stage cleanup wipes the root afterwards (production behavior).
+    assert not (env.root / "etc" / "base-release").exists()
+
+
+def test_preserve_root_restores(env, tmp_path):
+    from makisu_tpu.storage.root_preserver import RootPreserver
+    (env.root / "precious.txt").write_text("keep")
+    preserver = RootPreserver(str(env.root), str(tmp_path / "backup"), [])
+    env.file("f", "f")
+    env.build("FROM scratch\nRUN echo junk > junk.txt\n", modify_fs=True)
+    # Stage cleanup wiped the root (junk AND precious); restore brings
+    # the preserved tree back.
+    assert not (env.root / "precious.txt").exists()
+    preserver.restore()
+    assert not (env.root / "junk.txt").exists()
+    assert (env.root / "precious.txt").read_text() == "keep"
+
+
+def test_healthcheck_volume_expose_in_config(env):
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch\n"
+        "HEALTHCHECK --interval=30s --retries=3 CMD curl -f http://x/\n"
+        "VOLUME /data\n"
+        "EXPOSE 9000/udp\n"
+        "STOPSIGNAL 9\n"
+        "USER app\n"
+        "MAINTAINER dev <dev@x.io>\n")
+    cfg = env.config(m)
+    assert cfg.config.healthcheck.test[0] == "CMD-SHELL"
+    assert cfg.config.healthcheck.retries == 3
+    assert cfg.config.volumes == {"/data": {}}
+    assert "9000/udp" in cfg.config.exposed_ports
+    assert cfg.config.stop_signal == "9"
+    assert cfg.config.user == "app"
+    assert cfg.author == "dev <dev@x.io>"
+
+
+def test_deleted_file_whiteout_via_run(env):
+    env.file("temp.txt", "temp")
+    m = env.build(
+        "FROM scratch\n"
+        "COPY temp.txt /temp.txt #!COMMIT\n"
+        "RUN rm temp.txt\n",
+        modify_fs=True)
+    members = env.layers(m)
+    assert ".wh.temp.txt" in members

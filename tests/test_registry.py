@@ -115,3 +115,36 @@ def test_bad_upload_digest_rejected(store, fixture):
     store.layers.write_bytes("ab" * 32, b"some data")
     with pytest.raises(HTTPError):
         c.push_layer(Digest.from_hex("ab" * 32))  # digest != content
+
+
+def test_token_auth_dance(store):
+    fx = RegistryFixture(require_token="tok-xyz")
+    manifest, config_blob, blobs = make_test_image()
+    fx.serve_image("team/app", "v7", manifest, blobs)
+    c = client(store, fx)
+    pulled = c.pull_manifest("v7")
+    assert pulled.digest() == manifest.digest()
+    # The client obtained the token and retried with Bearer auth.
+    assert any("/token" in u for _, u in fx.requests)
+
+
+def test_basic_auth_header_sent(store, fixture):
+    from makisu_tpu.registry import SecurityConfig
+    manifest, config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "v8", manifest, blobs)
+    cfg = RegistryConfig()
+    cfg.security = SecurityConfig(basic_user="u", basic_password="p")
+    c = RegistryClient(store, "registry.test", "team/app", config=cfg,
+                       transport=fixture)
+
+    seen = {}
+    orig = fixture.round_trip
+
+    def spy(method, url, headers, body=None, timeout=60.0):
+        seen.setdefault("auth", headers.get("Authorization"))
+        return orig(method, url, headers, body, timeout)
+
+    fixture.round_trip = spy
+    c.pull_manifest("v8")
+    import base64
+    assert seen["auth"] == "Basic " + base64.b64encode(b"u:p").decode()
