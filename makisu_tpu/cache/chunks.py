@@ -69,7 +69,8 @@ class ChunkStore:
         return have / total
 
     def reconstitute(self, pair: DigestPair,
-                     chunks: list[tuple[int, int, str]]) -> bytes | None:
+                     chunks: list[tuple[int, int, str]],
+                     gz_backend: str | None = None) -> bytes | None:
         """Rebuild a layer blob from chunks; verify both digests.
         Returns None if any chunk is missing."""
         parts: list[bytes] = []
@@ -88,7 +89,7 @@ class ChunkStore:
                         pair.tar_digest)
             return None
         out = io.BytesIO()
-        with tario.gzip_writer(out) as gz:
+        with tario.gzip_writer(out, backend_id=gz_backend) as gz:
             gz.write(stream)
         blob = out.getvalue()
         if Digest.of_bytes(blob) != pair.gzip_descriptor.digest:
@@ -135,11 +136,13 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                     raw = None
             if raw is None:
                 raise
+            from makisu_tpu.cache.manager import entry_gzip_backend
             pair, chunks = decode_entry(raw)
             if pair is None or not chunks:
                 raise
             blob = chunk_store.reconstitute(
-                pair, [tuple(c) for c in chunks])
+                pair, [tuple(c) for c in chunks],
+                gz_backend=entry_gzip_backend(raw))
             if blob is None:
                 raise
             manager.store.layers.write_bytes(

@@ -37,10 +37,12 @@ def encode_entry(pair: DigestPair | None,
                  commit: LayerCommit | None = None) -> str:
     if pair is None:
         return EMPTY_ENTRY
+    from makisu_tpu import tario
     entry = {
         "tar": str(pair.tar_digest),
         "gzip": str(pair.gzip_descriptor.digest),
         "size": pair.gzip_descriptor.size,
+        "gz": tario.gzip_backend_id(),
     }
     if commit is not None and commit.chunks:
         entry["chunks"] = [[c.offset, c.length, c.hex_digest]
@@ -57,6 +59,13 @@ def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
         gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, entry["size"],
                                    Digest(entry["gzip"])))
     return pair, entry.get("chunks", [])
+
+
+def entry_gzip_backend(raw: str) -> str | None:
+    """Gzip backend id recorded in a cache entry (None for legacy)."""
+    if raw == EMPTY_ENTRY:
+        return None
+    return json.loads(raw).get("gz")
 
 
 class CacheManager:

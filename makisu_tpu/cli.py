@@ -77,6 +77,10 @@ def make_parser() -> argparse.ArgumentParser:
                             "$HOME fallback)")
     build.add_argument("--compression", default="default",
                        choices=sorted(tario.COMPRESSION_LEVELS))
+    build.add_argument("--gzip-backend", default="zlib",
+                       choices=["zlib", "pgzip"],
+                       help="layer compressor: stdlib zlib or the native "
+                            "parallel block-deflate (native/libpgzip.so)")
     build.add_argument("--preserve-root", action="store_true",
                        help="save and restore / around the build")
     build.add_argument("--root", default="/",
@@ -166,6 +170,7 @@ def cmd_build(args) -> int:
     if args.registry_config:
         update_global_config(args.registry_config)
     tario.set_compression(args.compression)
+    tario.set_gzip_backend(args.gzip_backend)
     for extra in args.blacklist:
         if extra not in pathutils.DEFAULT_BLACKLIST:
             pathutils.DEFAULT_BLACKLIST.append(extra)

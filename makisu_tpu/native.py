@@ -1,0 +1,176 @@
+"""ctypes bindings for the native runtime pieces (native/).
+
+``pgzip_compress``: parallel block-deflate gzip (native/pgzip.cpp) — the
+capability the reference gets from pgzip (lib/tario/gzip.go:46). Falls
+back cleanly when the shared library hasn't been built; callers check
+``pgzip_available()``.
+
+Build: ``make -C native`` (g++ + zlib; no extra dependencies).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpgzip.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+DEFAULT_BLOCK = 128 * 1024
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.isfile(_LIB_PATH):
+            # Best-effort build if the toolchain is present.
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except (OSError, subprocess.SubprocessError):
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.pgz_compress.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.pgz_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_size_t, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.pgz_block.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.pgz_block.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
+            lib.pgz_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            if lib.pgz_abi_version() != 1:
+                raise OSError("pgzip ABI mismatch")
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def pgzip_available() -> bool:
+    return _load() is not None
+
+
+def pgzip_compress(data: bytes, level: int = 6,
+                   block_size: int = DEFAULT_BLOCK,
+                   nthreads: int | None = None) -> bytes:
+    """Compress to a single deterministic gzip member using parallel
+    block deflate. Output depends only on (data, level, block_size)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native pgzip library unavailable; run "
+                           "`make -C native`")
+    if nthreads is None:
+        nthreads = os.cpu_count() or 1
+    out_n = ctypes.c_size_t(0)
+    buf = lib.pgz_compress(data, len(data), level, block_size, nthreads,
+                           ctypes.byref(out_n))
+    if not buf:
+        raise RuntimeError("pgz_compress failed")
+    try:
+        return ctypes.string_at(buf, out_n.value)
+    finally:
+        lib.pgz_free(buf)
+
+
+_GZIP_HEADER = bytes([0x1f, 0x8b, 0x08, 0, 0, 0, 0, 0, 0, 0xff])
+
+
+def _block_compress(data: bytes, level: int, last: bool) -> bytes:
+    lib = _load()
+    assert lib is not None
+    out_n = ctypes.c_size_t(0)
+    buf = lib.pgz_block(data, len(data), level, 1 if last else 0,
+                        ctypes.byref(out_n))
+    if not buf:
+        raise RuntimeError("pgz_block failed")
+    try:
+        return ctypes.string_at(buf, out_n.value)
+    finally:
+        lib.pgz_free(buf)
+
+
+class PgzipWriter:
+    """Streaming parallel gzip writer (file-like: write/flush/close).
+
+    Buffers ``block_size`` bytes at a time, compresses blocks on a thread
+    pool (ctypes releases the GIL during the native call), and writes
+    segments in order — bounded memory, identical output bytes to
+    ``pgzip_compress`` for the same (level, block_size).
+    """
+
+    def __init__(self, fileobj, level: int = 6,
+                 block_size: int = DEFAULT_BLOCK,
+                 workers: int | None = None) -> None:
+        if not pgzip_available():
+            raise RuntimeError("native pgzip library unavailable")
+        from concurrent.futures import ThreadPoolExecutor
+        import zlib
+        self._out = fileobj
+        self._level = level
+        self._block = block_size
+        self._buf = bytearray()
+        self._crc = zlib.crc32(b"")
+        self._size = 0
+        self._pool = ThreadPoolExecutor(workers or (os.cpu_count() or 1))
+        self._pending = []  # ordered futures
+        self._out.write(_GZIP_HEADER)
+        self._closed = False
+
+    def write(self, data: bytes) -> int:
+        import zlib
+        self._crc = zlib.crc32(data, self._crc)
+        self._size += len(data)
+        self._buf.extend(data)
+        while len(self._buf) >= self._block:
+            chunk = bytes(self._buf[:self._block])
+            del self._buf[:self._block]
+            self._pending.append(self._pool.submit(
+                _block_compress, chunk, self._level, False))
+            self._drain(max_pending=2 * (os.cpu_count() or 1))
+        return len(data)
+
+    def _drain(self, max_pending: int = 0) -> None:
+        """Write completed segments in order; block only when the queue
+        exceeds ``max_pending`` (bounds memory)."""
+        while self._pending:
+            if len(self._pending) > max_pending or self._pending[0].done():
+                self._out.write(self._pending.pop(0).result())
+            else:
+                break
+
+    def flush(self) -> None:
+        self._out.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._pending.append(self._pool.submit(
+            _block_compress, bytes(self._buf), self._level, True))
+        self._buf.clear()
+        for fut in self._pending:
+            self._out.write(fut.result())
+        self._pending = []
+        self._pool.shutdown()
+        trailer = (self._crc & 0xFFFFFFFF).to_bytes(4, "little") + \
+            (self._size & 0xFFFFFFFF).to_bytes(4, "little")
+        self._out.write(trailer)
+
+    def __enter__(self) -> "PgzipWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

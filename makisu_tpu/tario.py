@@ -23,6 +23,34 @@ COMPRESSION_LEVELS = {"no": 0, "speed": 1, "default": 6, "size": 9}
 
 _compression_level = COMPRESSION_LEVELS["default"]
 
+# Compressor backend: "zlib" (stdlib, single-stream) or "pgzip" (native
+# parallel block deflate, native/pgzip.cpp — the reference's multicore
+# pgzip capability). Both are deterministic, but produce different bytes,
+# so the backend id is part of a layer's cache identity (cache entries
+# record it; chunk reconstitution replays with the same backend).
+_gzip_backend = "zlib"
+_PGZIP_BLOCK = 128 * 1024
+
+
+def set_gzip_backend(name: str) -> None:
+    global _gzip_backend
+    if name not in ("zlib", "pgzip"):
+        raise ValueError(f"unknown gzip backend {name!r}")
+    if name == "pgzip":
+        from makisu_tpu.native import pgzip_available
+        if not pgzip_available():
+            raise ValueError(
+                "pgzip backend requested but native/libpgzip.so is not "
+                "available (run `make -C native`)")
+    _gzip_backend = name
+
+
+def gzip_backend_id(level: int | None = None) -> str:
+    level = _compression_level if level is None else level
+    if _gzip_backend == "pgzip":
+        return f"pgzip-{level}-{_PGZIP_BLOCK}"
+    return f"zlib-{level}"
+
 
 def set_compression(name: str) -> None:
     global _compression_level
@@ -38,8 +66,22 @@ def compression_level() -> int:
     return _compression_level
 
 
-def gzip_writer(fileobj: BinaryIO, level: int | None = None) -> gzip.GzipFile:
+def gzip_writer(fileobj: BinaryIO, level: int | None = None,
+                backend_id: str | None = None):
+    """Deterministic gzip writer. ``backend_id`` (from a cache entry)
+    forces a specific backend/level/block so reconstituted bytes match."""
     level = _compression_level if level is None else level
+    backend = _gzip_backend
+    block = _PGZIP_BLOCK
+    if backend_id is not None:
+        parts = backend_id.split("-")
+        backend = parts[0]
+        level = int(parts[1])
+        if backend == "pgzip":
+            block = int(parts[2])
+    if backend == "pgzip":
+        from makisu_tpu.native import PgzipWriter
+        return PgzipWriter(fileobj, level=level, block_size=block)
     return gzip.GzipFile(fileobj=fileobj, mode="wb", compresslevel=level,
                          mtime=0, filename="")
 

@@ -1,0 +1,159 @@
+// Parallel gzip: block-wise deflate with thread workers, one gzip member.
+//
+// The reference's compression hot path is multicore (pgzip,
+// lib/tario/gzip.go:46); CPython's gzip is single-stream. This module
+// compresses BLOCK-sized slices independently on a thread pool — each
+// worker deflates its slice as a raw stream ending in a sync-flush
+// (byte-aligned, no BFINAL), the last slice ends with Z_FINISH — and the
+// byte-concatenation is one valid deflate stream wrapped in a fixed gzip
+// header (mtime 0) + crc32/size trailer. Output is deterministic for a
+// given (level, block size), independent of thread count.
+//
+// C ABI (ctypes-friendly):
+//   pgz_compress(data, n, level, block_size, nthreads, &out_n) -> buf
+//   pgz_free(buf)
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <mutex>
+#include <new>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slice {
+  const uint8_t* data;
+  size_t len;
+  bool last;
+  std::vector<uint8_t> out;
+  bool done = false;
+};
+
+bool deflate_slice(Slice& s, int level) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  // windowBits -15: raw deflate (we write the gzip framing ourselves).
+  if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return false;
+  }
+  s.out.resize(deflateBound(&zs, s.len) + 16);
+  zs.next_in = const_cast<Bytef*>(s.data);
+  zs.avail_in = static_cast<uInt>(s.len);
+  zs.next_out = s.out.data();
+  zs.avail_out = static_cast<uInt>(s.out.size());
+  int rc = deflate(&zs, s.last ? Z_FINISH : Z_SYNC_FLUSH);
+  bool ok = s.last ? (rc == Z_STREAM_END) : (rc == Z_OK);
+  s.out.resize(zs.total_out);
+  deflateEnd(&zs);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Compresses `n` bytes; returns a malloc'd buffer (caller frees with
+// pgz_free) and writes its length to *out_n. Returns nullptr on error.
+uint8_t* pgz_compress(const uint8_t* data, size_t n, int level,
+                      size_t block_size, int nthreads, size_t* out_n) {
+  if (block_size == 0 || level < 0 || level > 9 || out_n == nullptr) {
+    return nullptr;
+  }
+  size_t nblocks = n == 0 ? 1 : (n + block_size - 1) / block_size;
+  std::vector<Slice> slices(nblocks);
+  for (size_t i = 0; i < nblocks; ++i) {
+    slices[i].data = data + i * block_size;
+    slices[i].len = (i + 1 == nblocks) ? n - i * block_size : block_size;
+    slices[i].last = (i + 1 == nblocks);
+  }
+
+  if (nthreads < 1) nthreads = 1;
+  std::mutex mu;
+  size_t next = 0;
+  bool failed = false;
+  auto worker = [&]() {
+    for (;;) {
+      size_t idx;
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        if (next >= nblocks || failed) return;
+        idx = next++;
+      }
+      if (!deflate_slice(slices[idx], level)) {
+        std::lock_guard<std::mutex> lock(mu);
+        failed = true;
+        return;
+      }
+    }
+  };
+  if (nthreads == 1 || nblocks == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    int spawn = nthreads < static_cast<int>(nblocks)
+                    ? nthreads
+                    : static_cast<int>(nblocks);
+    pool.reserve(spawn);
+    for (int i = 0; i < spawn; ++i) pool.emplace_back(worker);
+    for (auto& t : pool) t.join();
+  }
+  if (failed) return nullptr;
+
+  uLong crc = crc32(0L, Z_NULL, 0);
+  if (n > 0) {
+    // crc32 over the whole input; chunked to respect uInt widths.
+    size_t off = 0;
+    while (off < n) {
+      uInt step = static_cast<uInt>(
+          (n - off) < (1u << 30) ? (n - off) : (1u << 30));
+      crc = crc32(crc, data + off, step);
+      off += step;
+    }
+  }
+
+  size_t total = 10 + 8;  // header + trailer
+  for (auto& s : slices) total += s.out.size();
+  uint8_t* out = static_cast<uint8_t*>(::operator new(total, std::nothrow));
+  if (out == nullptr) return nullptr;
+  // Fixed gzip header: magic, deflate, no flags, mtime=0, XFL=0, OS=255.
+  const uint8_t header[10] = {0x1f, 0x8b, 0x08, 0, 0, 0, 0, 0, 0, 0xff};
+  std::memcpy(out, header, 10);
+  size_t pos = 10;
+  for (auto& s : slices) {
+    std::memcpy(out + pos, s.out.data(), s.out.size());
+    pos += s.out.size();
+  }
+  uint32_t crc32v = static_cast<uint32_t>(crc);
+  uint32_t isize = static_cast<uint32_t>(n & 0xffffffffu);
+  for (int i = 0; i < 4; ++i) out[pos++] = (crc32v >> (8 * i)) & 0xff;
+  for (int i = 0; i < 4; ++i) out[pos++] = (isize >> (8 * i)) & 0xff;
+  *out_n = pos;
+  return out;
+}
+
+void pgz_free(uint8_t* buf) { ::operator delete(buf); }
+
+// Compress ONE block as a raw-deflate segment (sync-flush terminated, or
+// Z_FINISH when last != 0). Lets a streaming caller run blocks on its own
+// worker pool with bounded memory and assemble header/trailer itself.
+uint8_t* pgz_block(const uint8_t* data, size_t n, int level, int last,
+                   size_t* out_n) {
+  if (out_n == nullptr || level < 0 || level > 9) return nullptr;
+  Slice s{data, n, last != 0, {}, false};
+  if (!deflate_slice(s, level)) return nullptr;
+  uint8_t* out =
+      static_cast<uint8_t*>(::operator new(s.out.size(), std::nothrow));
+  if (out == nullptr) return nullptr;
+  std::memcpy(out, s.out.data(), s.out.size());
+  *out_n = s.out.size();
+  return out;
+}
+
+int pgz_abi_version() { return 1; }
+
+}  // extern "C"

@@ -139,3 +139,54 @@ def test_get_hasher():
     assert get_hasher("tpu").name == "tpu"
     with pytest.raises(ValueError):
         get_hasher("gpu")
+
+
+# ---------------------------------------------------------------------------
+# Native pgzip backend
+# ---------------------------------------------------------------------------
+
+def test_native_pgzip_writer_matches_oneshot():
+    pytest.importorskip("makisu_tpu.native")
+    from makisu_tpu import native
+    if not native.pgzip_available():
+        pytest.skip("native pgzip not built")
+    payload = rand_bytes(1_000_000, 11)
+    out = io.BytesIO()
+    with native.PgzipWriter(out, level=6) as w:
+        for i in range(0, len(payload), 37_000):  # ragged writes
+            w.write(payload[i:i + 37_000])
+    streamed = out.getvalue()
+    assert streamed == native.pgzip_compress(payload, level=6)
+    assert gzip.decompress(streamed) == payload
+
+
+def test_pgzip_backend_layer_sink_and_reconstitution(tmp_path):
+    from makisu_tpu import native, tario
+    if not native.pgzip_available():
+        pytest.skip("native pgzip not built")
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.docker.image import Digest
+    payload = rand_bytes(300_000, 12)
+    tario.set_gzip_backend("pgzip")
+    try:
+        out = io.BytesIO()
+        sink = TPUHasher().open_layer(out)
+        sink.write(payload)
+        commit = sink.finish()
+        blob = out.getvalue()
+        assert gzip.decompress(blob) == payload
+        assert commit.digest_pair.gzip_descriptor.digest == \
+            Digest.of_bytes(blob)
+        # Reconstitution with the recorded backend id reproduces the
+        # exact blob.
+        store = ChunkStore(str(tmp_path / "chunks"))
+        for c in commit.chunks:
+            store.put(c.hex_digest,
+                      payload[c.offset:c.offset + c.length])
+        rebuilt = store.reconstitute(
+            commit.digest_pair,
+            [(c.offset, c.length, c.hex_digest) for c in commit.chunks],
+            gz_backend=tario.gzip_backend_id())
+        assert rebuilt == blob
+    finally:
+        tario.set_gzip_backend("zlib")
