@@ -97,6 +97,11 @@ class _LaneBatcher:
 class ChunkSession:
     """One layer stream → content-defined chunks with fingerprints."""
 
+    # How many gear dispatches may be in flight before the host blocks on
+    # the oldest bitmap. Depth 2 overlaps device scan + readback with the
+    # caller producing the next block (tar writing / file IO).
+    PIPELINE_DEPTH = 2
+
     def __init__(self, avg_bits: int = gear.DEFAULT_AVG_BITS,
                  min_size: int = gear.DEFAULT_MIN_SIZE,
                  max_size: int = gear.DEFAULT_MAX_SIZE,
@@ -110,9 +115,10 @@ class ChunkSession:
         self._staging = bytearray()   # bytes not yet gear-scanned
         self._tail = bytearray()      # scanned bytes after the last cut
         self._tail_offset = 0         # stream offset of _tail[0]
-        self._scanned = 0             # stream bytes gear-scanned so far
+        self._scanned = 0             # stream bytes gear-dispatched so far
         self._halo = b""              # last WINDOW bytes of previous block
         self._prev_cut = 0            # stream offset of the last cut
+        self._inflight: list[tuple] = []  # dispatched, unprocessed blocks
         self._batchers = [_LaneBatcher(cap, lanes)
                           for cap, lanes in _BUCKETS]
         self._chunks: list[Chunk] = []
@@ -124,14 +130,16 @@ class ChunkSession:
         while len(self._staging) >= self.block:
             blk = bytes(self._staging[:self.block])
             del self._staging[:self.block]
-            self._scan_block(blk)
+            self._dispatch_block(blk)
 
     def finish(self) -> list[Chunk]:
         if self._staging:
             blk = bytes(self._staging)
             pad = (-len(blk)) % 32
-            self._scan_block(blk + b"\x00" * pad, live=len(blk))
+            self._dispatch_block(blk + b"\x00" * pad, live=len(blk))
             self._staging.clear()
+        while self._inflight:
+            self._process_block(self._inflight.pop(0))
         # Final chunk: whatever follows the last cut.
         if self._tail:
             self._emit(bytes(self._tail), self._tail_offset)
@@ -143,24 +151,32 @@ class ChunkSession:
 
     # -- internals --------------------------------------------------------
 
-    def _scan_block(self, blk: bytes, live: int | None = None) -> None:
-        """Gear-scan one block (plus halo) and cut chunks at candidates."""
+    def _dispatch_block(self, blk: bytes, live: int | None = None) -> None:
+        """Ship one block to the device (async); process the oldest
+        in-flight block when the pipeline is full."""
         live = len(blk) if live is None else live
         halo = self._halo
         buf = np.frombuffer(halo + blk, dtype=np.uint8)
-        words = np.asarray(gear.gear_bitmap(buf, self.avg_bits))
-        bits = gear.unpack_bits_np(words, len(buf))[len(halo):len(halo) + live]
-        base = self._scanned  # stream offset of blk[0]
+        words = gear.gear_bitmap(buf, self.avg_bits)  # async dispatch
+        self._inflight.append((words, len(halo), live, blk, self._scanned))
+        self._scanned += live
+        self._halo = (halo + blk)[-(gear.WINDOW):]
+        while len(self._inflight) > self.PIPELINE_DEPTH:
+            self._process_block(self._inflight.pop(0))
+
+    def _process_block(self, entry: tuple) -> None:
+        """Read back one block's bitmap (sync) and cut chunks."""
+        words, halo_len, live, blk, base = entry
+        host_words = np.asarray(words)
+        bits = gear.unpack_bits_np(
+            host_words, halo_len + live)[halo_len:halo_len + live]
         candidates = np.nonzero(bits)[0] + base
         self._tail.extend(blk[:live])
         for pos in candidates:
-            end = int(pos) + 1  # cut AFTER the boundary byte
-            self._cut_to(end)
+            self._cut_to(int(pos) + 1)  # cut AFTER the boundary byte
         # Oversize tail without candidates: force max-size cuts.
         while len(self._tail) > self.max_size:
             self._force_cut(self._tail_offset + self.max_size)
-        self._scanned += live
-        self._halo = (halo + blk)[-(gear.WINDOW):]
 
     def _cut_to(self, end: int) -> None:
         if end - self._prev_cut < self.min_size:

@@ -31,6 +31,9 @@ def make_parser() -> argparse.ArgumentParser:
                         choices=["json", "console"])
     parser.add_argument("--cpu-profile", action="store_true",
                         help="write a cProfile dump to /tmp/makisu-tpu.prof")
+    parser.add_argument("--jax-profile", default="", metavar="DIR",
+                        help="capture a JAX/XLA profiler trace (xprof) of "
+                             "the accelerator hashing path into DIR")
     sub = parser.add_subparsers(dest="command")
 
     build = sub.add_parser("build", help="build a docker image")
@@ -338,6 +341,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.cpu_profile:
         profiler = cProfile.Profile()
         profiler.enable()
+    jax_trace = False
+    if getattr(args, "jax_profile", ""):
+        import jax
+        jax.profiler.start_trace(args.jax_profile)
+        jax_trace = True
     try:
         return handler(args)
     except Exception as e:  # noqa: BLE001 - top-level CLI boundary
@@ -346,6 +354,10 @@ def main(argv: list[str] | None = None) -> int:
             raise
         return 1
     finally:
+        if jax_trace:
+            import jax
+            jax.profiler.stop_trace()
+            log.info("jax profiler trace written to %s", args.jax_profile)
         if profiler is not None:
             profiler.disable()
             profiler.dump_stats("/tmp/makisu-tpu.prof")
