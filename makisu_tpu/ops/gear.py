@@ -77,6 +77,19 @@ def _shift_seq(h: jax.Array, m: int) -> jax.Array:
     return jnp.pad(h, pad)[..., :-m]
 
 
+def _gear_value(data: jax.Array) -> jax.Array:
+    """G[b] computed arithmetically — bit-identical to ``gear_table()[b]``
+    but with no gather: table index i holds splitmix32 of
+    ``seed + i*GOLDEN``, so the lookup is an 8-op elementwise mix chain,
+    which maps onto the VPU far better than a 256-entry gather."""
+    x = data.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + jnp.uint32(
+        0x6D616B69)
+    z = x + jnp.uint32(0x9E3779B9)
+    z = (z ^ (z >> jnp.uint32(16))) * jnp.uint32(0x21F0AAAD)
+    z = (z ^ (z >> jnp.uint32(15))) * jnp.uint32(0x735A2D97)
+    return z ^ (z >> jnp.uint32(15))
+
+
 def gear_hash(data: jax.Array) -> jax.Array:
     """Per-position Gear hashes for uint8 data [..., N].
 
@@ -84,9 +97,7 @@ def gear_hash(data: jax.Array) -> jax.Array:
     treated as starting at index 0 (zero history). For segmented streams
     pass 31 bytes of left halo and drop the first 31 outputs.
     """
-    table = jnp.asarray(gear_table())
-    g = table[data.astype(jnp.int32)]
-    h = g
+    h = _gear_value(data)
     m = 1
     while m < WINDOW:
         h = h + (_shift_seq(h, m) << jnp.uint32(m))

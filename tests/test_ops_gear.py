@@ -65,3 +65,12 @@ def test_select_boundaries_deterministic_and_covering():
 def test_empty_stream():
     cuts = gear.select_boundaries_np(np.array([], dtype=np.int64), n=0)
     np.testing.assert_array_equal(cuts, [0])
+
+
+def test_arithmetic_gear_value_matches_table():
+    """The gather-free mix chain must reproduce the table exactly —
+    chunk boundaries (and so cache keys) depend on these values."""
+    import jax.numpy as jnp
+    all_bytes = np.arange(256, dtype=np.uint8)
+    got = np.asarray(gear._gear_value(jnp.asarray(all_bytes)))
+    np.testing.assert_array_equal(got, gear.gear_table())
