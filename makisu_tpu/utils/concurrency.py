@@ -1,0 +1,63 @@
+"""Bounded worker pool.
+
+Reference capability: lib/concurrency/worker_pool.go (fixed-N goroutine
+pool; Do blocks when the queue is full; Stop/Wait join). Python's
+ThreadPoolExecutor has an unbounded queue, which for layer transfers
+means unbounded memory; this pool applies backpressure instead.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class WorkerPool:
+    def __init__(self, workers: int, queue_depth: int | None = None) -> None:
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._tasks: queue.Queue = queue.Queue(queue_depth or workers)
+        self._errors: list[BaseException] = []
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"workerpool-{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self) -> None:
+        while True:
+            task = self._tasks.get()
+            if task is None:
+                return
+            if not self._stopped.is_set():
+                try:
+                    task()
+                except BaseException as e:  # noqa: BLE001
+                    with self._lock:
+                        self._errors.append(e)
+            self._tasks.task_done()
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Enqueue work; blocks when the queue is full (backpressure)."""
+        if self._stopped.is_set():
+            raise RuntimeError("pool is stopped")
+        self._tasks.put(fn)
+
+    def stop(self) -> None:
+        """Drop not-yet-started tasks and join workers."""
+        self._stopped.set()
+        self.wait()
+
+    def wait(self) -> list[BaseException]:
+        """Join all queued work, shut down workers, return errors."""
+        for _ in self._threads:
+            self._tasks.put(None)
+        for t in self._threads:
+            t.join()
+        with self._lock:
+            return list(self._errors)

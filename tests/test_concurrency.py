@@ -1,0 +1,46 @@
+"""WorkerPool tests (reference: lib/concurrency/worker_pool.go)."""
+
+import threading
+import time
+
+from makisu_tpu.utils.concurrency import WorkerPool
+
+
+def test_all_tasks_run():
+    pool = WorkerPool(4)
+    done = []
+    lock = threading.Lock()
+    for i in range(50):
+        def task(i=i):
+            with lock:
+                done.append(i)
+        pool.submit(task)
+    assert pool.wait() == []
+    assert sorted(done) == list(range(50))
+
+
+def test_errors_collected_without_killing_pool():
+    pool = WorkerPool(2)
+    ran = []
+    pool.submit(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    pool.submit(lambda: ran.append(1))
+    errors = pool.wait()
+    assert len(errors) == 1 and isinstance(errors[0], ValueError)
+    assert ran == [1]
+
+
+def test_submit_applies_backpressure():
+    pool = WorkerPool(1, queue_depth=1)
+    release = threading.Event()
+    pool.submit(release.wait)  # occupies the worker
+    pool.submit(lambda: None)  # fills the queue
+    t0 = time.time()
+
+    def unblock():
+        time.sleep(0.2)
+        release.set()
+
+    threading.Thread(target=unblock).start()
+    pool.submit(lambda: None)  # must block until the worker drains
+    assert time.time() - t0 > 0.1
+    pool.wait()
