@@ -310,7 +310,19 @@ class RegistryClient:
                    accepted=(201, 204))
 
 
+# Test seam: when set, new_client routes through this factory instead of
+# real HTTP (lets the pull/push/diff CLI commands run against fixtures).
+_transport_factory: "Callable[[ImageName], Transport] | None" = None
+
+
+def set_transport_factory(factory) -> None:
+    global _transport_factory
+    _transport_factory = factory
+
+
 def new_client(store: ImageStore, name: ImageName,
                transport: Transport | None = None) -> RegistryClient:
+    if transport is None and _transport_factory is not None:
+        transport = _transport_factory(name)
     return RegistryClient(store, name.registry or "index.docker.io",
                           name.repository, transport=transport)

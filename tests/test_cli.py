@@ -77,3 +77,60 @@ def test_cli_subprocess_entrypoint(tmp_path, context):
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0
     assert out.stdout.strip()
+
+
+def _with_fixture_registry(images):
+    """Route CLI registry traffic to an in-process fixture serving
+    {(repo, tag): files_dict}."""
+    from makisu_tpu.registry import RegistryFixture, make_test_image
+    from makisu_tpu.registry import client as client_mod
+    fixture = RegistryFixture()
+    for (repo, tag), files in images.items():
+        manifest, _, blobs = make_test_image(files)
+        fixture.serve_image(repo, tag, manifest, blobs)
+    client_mod.set_transport_factory(lambda name: fixture)
+    return fixture
+
+
+@pytest.fixture
+def fixture_registry():
+    yield _with_fixture_registry
+    from makisu_tpu.registry import client as client_mod
+    client_mod.set_transport_factory(None)
+
+
+def test_cli_pull_extract(tmp_path, fixture_registry):
+    fixture_registry({("library/busy", "v1"): {"bin/sh": b"#!"}})
+    dest = tmp_path / "rootfs"
+    rc = cli.main(["pull", "busy:v1", "--extract", str(dest),
+                   "--storage", str(tmp_path / "s")])
+    assert rc == 0
+    assert (dest / "bin" / "sh").read_bytes() == b"#!"
+
+
+def test_cli_diff(tmp_path, fixture_registry, capsys):
+    fixture_registry({
+        ("library/imga", "latest"): {"common": b"same", "only-a": b"a"},
+        ("library/imgb", "latest"): {"common": b"same", "only-b": b"bb"},
+    })
+    rc = cli.main(["diff", "imga", "imgb",
+                   "--storage", str(tmp_path / "s")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "only-a" in out and "only-b" in out
+    assert "common" not in out
+
+
+def test_cli_push_tar(tmp_path, fixture_registry, context):
+    fixture = fixture_registry({})
+    root = tmp_path / "root"
+    root.mkdir()
+    dest = tmp_path / "image.tar"
+    assert cli.main(["build", str(context), "-t", "team/pushme:1",
+                     "--storage", str(tmp_path / "s1"),
+                     "--root", str(root), "--dest", str(dest)]) == 0
+    rc = cli.main(["push", str(dest), "-t", "team/pushme:1",
+                   "--push", "registry.test",
+                   "--storage", str(tmp_path / "s2")])
+    assert rc == 0
+    assert "team/pushme:1" in fixture.manifests
