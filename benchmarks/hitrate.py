@@ -1,0 +1,155 @@
+"""Warm-cache hit-rate benchmark: chunk-granular vs whole-layer dedup.
+
+Scenario (BASELINE.md config 3/4, scaled by --files/--bytes): build a
+many-file context, edit a small fraction of files, rebuild on a "second
+machine" (fresh layer store, shared KV + chunk store). Measures the
+fraction of layer bytes that did NOT need re-transfer:
+
+- whole-layer dedup (the reference's cache): a layer is reusable only if
+  its digest is unchanged — any edit re-transfers the whole layer.
+- chunk dedup (this framework): unchanged chunks are reused; only edited
+  chunks move.
+
+Prints one JSON line with both rates and the ratio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_context(path: str, n_files: int, total_bytes: int,
+                 seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    per_file = max(total_bytes // n_files, 16)
+    os.makedirs(path, exist_ok=True)
+    for i in range(n_files):
+        sub = os.path.join(path, f"pkg{i % 97:02d}")
+        os.makedirs(sub, exist_ok=True)
+        data = rng.integers(0, 256, size=per_file, dtype=np.uint8)
+        with open(os.path.join(sub, f"mod{i:05d}.bin"), "wb") as f:
+            f.write(data.tobytes())
+
+
+def edit_fraction(path: str, fraction: float, seed: int) -> int:
+    rng = np.random.default_rng(seed)
+    edited = 0
+    for dirpath, _, files in os.walk(path):
+        for fn in sorted(files):
+            if rng.random() < fraction:
+                p = os.path.join(dirpath, fn)
+                with open(p, "r+b") as f:
+                    f.seek(0)
+                    f.write(b"EDITED!!" )
+                edited += 1
+    return edited
+
+
+def run(n_files: int, total_bytes: int, edit_frac: float) -> dict:
+    from makisu_tpu.builder import BuildPlan
+    from makisu_tpu.cache import CacheManager, MemoryStore
+    from makisu_tpu.cache.chunks import ChunkStore, attach_chunk_dedup
+    from makisu_tpu.chunker import TPUHasher
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.dockerfile import parse_file
+    from makisu_tpu.storage import ImageStore
+    from makisu_tpu.utils import mountinfo
+
+    mountinfo.set_mountpoints_for_testing(set())
+    work = tempfile.mkdtemp(prefix="hitrate-")
+    try:
+        ctx_dir = os.path.join(work, "ctx")
+        make_context(ctx_dir, n_files, total_bytes, seed=0)
+        kv = MemoryStore()
+        chunk_root = os.path.join(work, "chunks")
+
+        def build(tag: str, store_name: str):
+            root = os.path.join(work, f"root-{tag}")
+            os.makedirs(root, exist_ok=True)
+            store = ImageStore(os.path.join(work, store_name))
+            ctx = BuildContext(root, ctx_dir, store, hasher=TPUHasher(),
+                               sync_wait=0.0)
+            mgr = CacheManager(kv, store)
+            attach_chunk_dedup(mgr, chunk_root)
+            plan = BuildPlan(
+                ctx, ImageName("", "bench/hitrate", tag), [], mgr,
+                parse_file("FROM scratch\nCOPY . /srv/\n"),
+                allow_modify_fs=False, force_commit=True)
+            manifest = plan.execute()
+            mgr.wait_for_push()
+            return manifest, mgr
+
+        manifest1, _ = build("v1", "store-1")
+        edited = edit_fraction(ctx_dir, edit_frac, seed=1)
+
+        # Second machine: fresh layer store, shared KV/chunk plane.
+        chunk_store = ChunkStore(chunk_root)
+        # Measure coverage of the *new* build's layers before building:
+        # chunk its layer and ask how many bytes already exist.
+        manifest2, mgr2 = build("v2", "store-2")
+        entries = [json.loads(v)
+                   for v in kv._data.values()
+                   if v != "MAKISU_TPU_CACHE_EMPTY"]
+        new_digests = {l.digest.hex() for l in manifest2.layers}
+        old_digests = {l.digest.hex() for l in manifest1.layers}
+        chunk_rates = []
+        layer_bytes = 0
+        for e in entries:
+            if "chunks" not in e:
+                continue
+            if e["gzip"].split(":")[1] not in new_digests:
+                continue
+            total = sum(c[1] for c in e["chunks"])
+            # Chunks indexed by build 1 only (exclude chunks first seen in
+            # build 2 by checking against build-1 digest overlap): the
+            # chunk store now holds both, so recompute reuse as chunks
+            # shared with build 1's entries.
+            chunk_rates.append((e, total))
+            layer_bytes += total
+        old_chunk_ids = set()
+        for e in entries:
+            if "chunks" in e and e["gzip"].split(":")[1] in old_digests:
+                old_chunk_ids.update(c[2] for c in e["chunks"])
+        reused = 0
+        for e, total in chunk_rates:
+            reused += sum(c[1] for c in e["chunks"] if c[2] in old_chunk_ids)
+        chunk_hit = reused / layer_bytes if layer_bytes else 0.0
+        whole_layer_hit = (
+            sum(l.size for l in manifest2.layers
+                if l.digest.hex() in old_digests)
+            / max(sum(l.size for l in manifest2.layers), 1))
+        return {
+            "files": n_files,
+            "bytes": total_bytes,
+            "edited_files": edited,
+            "whole_layer_hit_rate": round(whole_layer_hit, 4),
+            "chunk_hit_rate": round(chunk_hit, 4),
+            "ratio": round(chunk_hit / whole_layer_hit, 2)
+            if whole_layer_hit else float("inf"),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=2000)
+    ap.add_argument("--bytes", type=int, default=64 * 1024 * 1024)
+    ap.add_argument("--edit-fraction", type=float, default=0.01)
+    args = ap.parse_args()
+    print(json.dumps(run(args.files, args.bytes, args.edit_fraction)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
