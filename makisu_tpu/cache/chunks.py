@@ -28,13 +28,58 @@ from makisu_tpu.utils import logging as log
 
 
 class ChunkStore:
-    """CAS of uncompressed-stream chunks, keyed by hex sha256."""
+    """CAS of uncompressed-stream chunks, keyed by hex sha256.
 
-    def __init__(self, root: str, max_entries: int = 65536) -> None:
+    With a registry client attached, chunks also ride the registry's
+    blob plane (a chunk digest is a valid blob digest): new chunks push
+    on index, missing chunks fetch on demand — the DCN-distributed half
+    of chunk dedup, reusing the same infrastructure as layer blobs.
+    """
+
+    def __init__(self, root: str, max_entries: int = 65536,
+                 registry_client=None) -> None:
         self.cas = CASStore(root, max_entries)
+        self.registry = registry_client
+
+    def set_remote(self, layer_client) -> None:
+        """Attach a registry client; chunk blobs transfer straight into
+        this CAS (the client template supplies registry/auth/transport)."""
+        if layer_client is None:
+            self.registry = None
+            return
+        from makisu_tpu.registry.client import RegistryClient
+
+        class _CASOnlyStore:
+            """Just enough ImageStore surface for blob transfers."""
+
+            def __init__(self, cas) -> None:
+                self.layers = cas
+
+        self.registry = RegistryClient(
+            _CASOnlyStore(self.cas), layer_client.registry,
+            layer_client.repository, config=layer_client.config,
+            transport=layer_client.transport)
 
     def has(self, hex_digest: str) -> bool:
-        return self.cas.exists(hex_digest)
+        if self.cas.exists(hex_digest):
+            return True
+        if self.registry is not None:
+            return self._fetch_remote(hex_digest)
+        return False
+
+    def push_remote(self, hex_digest: str) -> None:
+        from makisu_tpu.docker.image import Digest
+        if self.registry is not None:
+            self.registry.push_layer(Digest.from_hex(hex_digest))
+
+    def _fetch_remote(self, hex_digest: str) -> bool:
+        from makisu_tpu.docker.image import Digest
+        try:
+            self.registry.pull_layer(Digest.from_hex(hex_digest))
+            return self.cas.exists(hex_digest)
+        except Exception as e:  # noqa: BLE001 - remote miss/network
+            log.debug("remote chunk %s unavailable: %s", hex_digest, e)
+            return False
 
     def get(self, hex_digest: str) -> bytes:
         with self.cas.open(hex_digest) as f:
@@ -105,8 +150,12 @@ class ChunkStore:
 
 def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
     """Wire a ChunkStore into a CacheManager: index chunks on push,
-    reconstitute layers on pull when the blob is missing locally."""
+    reconstitute layers on pull when the blob is missing locally. If the
+    manager has a registry client, chunks also distribute through the
+    registry blob plane."""
     chunk_store = ChunkStore(chunk_root)
+    if getattr(manager, "registry", None) is not None:
+        chunk_store.set_remote(manager.registry)
     inner_push = manager.push_cache
     inner_pull = manager.pull_cache
 
@@ -116,10 +165,18 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
             try:
                 path = manager.store.layers.path(
                     pair.gzip_descriptor.digest.hex())
-                added = chunk_store.index_layer(
-                    path, [(c.offset, c.length, c.hex_digest)
-                           for c in commit.chunks])
+                triples = [(c.offset, c.length, c.hex_digest)
+                           for c in commit.chunks]
+                added = chunk_store.index_layer(path, triples)
                 log.info("indexed %d new chunks for %s", added, cache_id)
+                if chunk_store.registry is not None:
+                    for _, _, hex_digest in triples:
+                        try:
+                            chunk_store.push_remote(hex_digest)
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("chunk push %s failed: %s",
+                                        hex_digest, e)
+                            break
             except FileNotFoundError:
                 pass
 

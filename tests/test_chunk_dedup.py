@@ -123,3 +123,51 @@ def test_chunk_put_verifies_digest(tmp_path):
     store = ChunkStore(str(tmp_path / "chunks"))
     with pytest.raises(ValueError):
         store.put("00" * 32, b"not matching")
+
+
+def test_chunks_distribute_through_registry_plane(tmp_path):
+    """Two builders with SEPARATE chunk stores sharing only KV + registry:
+    chunk blobs travel via the registry blob protocol."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    payload = np.random.default_rng(3).integers(
+        0, 256, size=120_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "blob.bin").write_bytes(payload)
+
+    def one_builder(tag, store_name, chunk_name):
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/chunks",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        attach_chunk_dedup(mgr, str(tmp_path / chunk_name))
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/remote", tag), [], mgr,
+                         stages, allow_modify_fs=False, force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        return manifest, store
+
+    m1, _ = one_builder("a", "store-a", "chunks-a")
+    assert fixture.blobs  # chunks + layers pushed to the registry
+    # Builder B: empty layer store AND empty chunk store. Simulate the
+    # layer blob being evicted from the registry (only chunks remain) so
+    # reconstitution is the only path.
+    layer_hex = m1.layers[0].digest.hex()
+    evicted = fixture.blobs.pop(layer_hex)
+    m2, store_b = one_builder("b", "store-b", "chunks-b")
+    assert [str(l.digest) for l in m1.layers] == \
+        [str(l.digest) for l in m2.layers]
+    assert store_b.layers.exists(layer_hex)
+    with store_b.layers.open(layer_hex) as f:
+        assert f.read() == evicted  # byte-identical reconstitution
