@@ -36,10 +36,9 @@ class ChunkStore:
     of chunk dedup, reusing the same infrastructure as layer blobs.
     """
 
-    def __init__(self, root: str, max_entries: int = 65536,
-                 registry_client=None) -> None:
+    def __init__(self, root: str, max_entries: int = 65536) -> None:
         self.cas = CASStore(root, max_entries)
-        self.registry = registry_client
+        self.registry = None  # attach via set_remote()
 
     def set_remote(self, layer_client) -> None:
         """Attach a registry client; chunk blobs transfer straight into
@@ -68,18 +67,26 @@ class ChunkStore:
         return False
 
     def push_remote(self, hex_digest: str) -> None:
-        from makisu_tpu.docker.image import Digest
         if self.registry is not None:
             self.registry.push_layer(Digest.from_hex(hex_digest))
 
     def _fetch_remote(self, hex_digest: str) -> bool:
-        from makisu_tpu.docker.image import Digest
         try:
             self.registry.pull_layer(Digest.from_hex(hex_digest))
-            return self.cas.exists(hex_digest)
         except Exception as e:  # noqa: BLE001 - remote miss/network
             log.debug("remote chunk %s unavailable: %s", hex_digest, e)
             return False
+        if not self.cas.exists(hex_digest):
+            return False
+        # pull_layer trusts the wire; chunks must be digest-verified or a
+        # corrupt response would poison the CAS forever (has() would keep
+        # returning True while every reconstitution fails).
+        if hashlib.sha256(self.get(hex_digest)).hexdigest() != hex_digest:
+            log.warning("remote chunk %s failed verification; discarding",
+                        hex_digest)
+            self.cas.delete(hex_digest)
+            return False
+        return True
 
     def get(self, hex_digest: str) -> bytes:
         with self.cas.open(hex_digest) as f:
@@ -91,17 +98,18 @@ class ChunkStore:
         self.cas.write_bytes(hex_digest, data)
 
     def index_layer(self, layer_blob_path: str,
-                    chunks: list[tuple[int, int, str]]) -> int:
+                    chunks: list[tuple[int, int, str]]) -> list[str]:
         """Slice a layer's uncompressed stream into its chunks and store
-        any that are new. Returns the number of chunks added."""
+        any that are new locally (never fetching: the bytes are already
+        in hand). Returns the hex digests newly added."""
         with open(layer_blob_path, "rb") as f:
             stream = gzip_mod.decompress(f.read())
-        added = 0
+        added: list[str] = []
         for offset, length, hex_digest in chunks:
-            if self.has(hex_digest):
+            if self.cas.exists(hex_digest):
                 continue
             self.put(hex_digest, stream[offset:offset + length])
-            added += 1
+            added.append(hex_digest)
         return added
 
     def coverage(self, chunks: list[tuple[int, int, str]]) -> float:
@@ -168,17 +176,27 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 triples = [(c.offset, c.length, c.hex_digest)
                            for c in commit.chunks]
                 added = chunk_store.index_layer(path, triples)
-                log.info("indexed %d new chunks for %s", added, cache_id)
-                if chunk_store.registry is not None:
-                    for _, _, hex_digest in triples:
+                log.info("indexed %d new chunks for %s", len(added),
+                         cache_id)
+            except FileNotFoundError:
+                return
+            if chunk_store.registry is not None and added:
+                # Off the build thread, like layer pushes; only the chunks
+                # this layer introduced.
+                def push_chunks(added=added):
+                    for hex_digest in added:
                         try:
                             chunk_store.push_remote(hex_digest)
                         except Exception as e:  # noqa: BLE001
                             log.warning("chunk push %s failed: %s",
                                         hex_digest, e)
-                            break
-            except FileNotFoundError:
-                pass
+                            return
+                import threading
+                t = threading.Thread(target=push_chunks, daemon=True,
+                                     name=f"chunkpush-{cache_id}")
+                t.start()
+                with manager._lock:
+                    manager._pushes.append(t)
 
     def pull_cache(cache_id):
         from makisu_tpu.cache.manager import CacheMiss, decode_entry
