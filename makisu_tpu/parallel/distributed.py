@@ -1,0 +1,43 @@
+"""Multi-host initialization for the hashing mesh.
+
+Single-host meshes need nothing; across hosts, JAX's distributed runtime
+brings every process's devices into one global mesh, and the same
+``(data, seq)`` shardings from parallel/mesh.py apply — XLA routes the
+Gear-halo ppermute over ICI within a slice and DCN across slices. This is
+the whole multi-host communication story: no hand-rolled backend
+(SURVEY.md §5 "distributed communication backend" mapping).
+
+Environment-driven (k8s-friendly), mirroring jax.distributed defaults:
+  MAKISU_TPU_COORDINATOR   host:port of process 0
+  MAKISU_TPU_NUM_PROCESSES total process count
+  MAKISU_TPU_PROCESS_ID    this process's index
+"""
+
+from __future__ import annotations
+
+import os
+
+from makisu_tpu.utils import logging as log
+
+_initialized = False
+
+
+def initialize_multihost() -> bool:
+    """Initialize jax.distributed from the environment; returns True if a
+    multi-host setup was configured (False = single-host, no-op)."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = os.environ.get("MAKISU_TPU_COORDINATOR", "")
+    if not coordinator:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(os.environ["MAKISU_TPU_NUM_PROCESSES"]),
+        process_id=int(os.environ["MAKISU_TPU_PROCESS_ID"]))
+    _initialized = True
+    log.info("joined distributed mesh",
+             process=os.environ["MAKISU_TPU_PROCESS_ID"],
+             processes=os.environ["MAKISU_TPU_NUM_PROCESSES"])
+    return True

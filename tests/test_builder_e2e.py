@@ -225,3 +225,55 @@ def test_tpu_hasher_build_records_chunks(env, tmp_path):
     entries = [json.loads(v) for v in kv._data.values()
                if v != "MAKISU_TPU_CACHE_EMPTY"]
     assert any("chunks" in e for e in entries)
+
+
+def test_cache_manager_thread_safety(tmp_path):
+    """Concurrent push/pull against one manager (the reference runs its
+    storage suites under stress; -race parity for our threaded paths)."""
+    import threading
+
+    from makisu_tpu.cache import CacheManager, MemoryStore
+    from makisu_tpu.cache.manager import CacheMiss
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_LAYER,
+        Descriptor,
+        Digest,
+        DigestPair,
+    )
+    from makisu_tpu.storage import ImageStore
+
+    store = ImageStore(str(tmp_path / "s"))
+    mgr = CacheManager(MemoryStore(), store)
+    errors = []
+
+    def pusher(i):
+        try:
+            for j in range(20):
+                blob = f"{i}-{j}".encode()
+                digest = Digest.of_bytes(blob)
+                store.layers.write_bytes(digest.hex(), blob)
+                pair = DigestPair(digest, Descriptor(
+                    MEDIA_TYPE_LAYER, len(blob), digest))
+                mgr.push_cache(f"id-{i}-{j}", pair)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def puller(i):
+        try:
+            for j in range(20):
+                try:
+                    mgr.pull_cache(f"id-{i}-{j}")
+                except CacheMiss:
+                    pass
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=fn, args=(i,))
+               for i in range(4) for fn in (pusher, puller)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mgr.wait_for_push()
+    assert not errors
+    assert mgr.pull_cache("id-0-0") is not None

@@ -134,3 +134,15 @@ def test_cli_push_tar(tmp_path, fixture_registry, context):
                    "--storage", str(tmp_path / "s2")])
     assert rc == 0
     assert "team/pushme:1" in fixture.manifests
+
+
+def test_cli_build_push(tmp_path, fixture_registry, context):
+    fixture = fixture_registry({})
+    root = tmp_path / "root"
+    root.mkdir()
+    rc = cli.main(["build", str(context), "-t", "team/direct:2",
+                   "--storage", str(tmp_path / "s"),
+                   "--root", str(root),
+                   "--push", "registry.test"])
+    assert rc == 0
+    assert "team/direct:2" in fixture.manifests
