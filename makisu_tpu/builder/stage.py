@@ -127,12 +127,23 @@ class BuildStage:
             start = time.time()
             config = node.build(cache_mgr, config, opts)
             log.info("step %d done", i + 1, duration=time.time() - start)
-            for pair in node.digest_pairs or []:
-                diff_ids.append(str(pair.tar_digest))
+            if node.digest_pairs:
+                for pair in node.digest_pairs:
+                    diff_ids.append(str(pair.tar_digest))
+                    histories.append(History(
+                        created=_now_iso(),
+                        created_by=f"makisu-tpu: {node.step.directive} "
+                                   f"{node.step.args}",
+                        author="makisu-tpu"))
+            else:
+                # Docker-spec fidelity: layer-less steps still appear in
+                # the config history, flagged empty_layer.
                 histories.append(History(
                     created=_now_iso(),
-                    created_by=f"makisu-tpu: {node}",
-                    author="makisu-tpu"))
+                    created_by=f"makisu-tpu: {node.step.directive} "
+                               f"{node.step.args}",
+                    author="makisu-tpu",
+                    empty_layer=True))
         assert config is not None
         config.created = _now_iso()
         config.history = histories

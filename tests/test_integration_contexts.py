@@ -232,3 +232,28 @@ def test_deleted_file_whiteout_via_run(env):
         modify_fs=True)
     members = env.layers(m)
     assert ".wh.temp.txt" in members
+
+
+def test_examples_build(env):
+    """The shipped example contexts must actually build."""
+    import shutil
+    repo_examples = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "examples")
+    for name, modify_fs in (("hello", False), ("multistage", True)):
+        src = os.path.join(repo_examples, name)
+        shutil.rmtree(env.ctx_dir, ignore_errors=True)
+        shutil.copytree(src, env.ctx_dir)
+        with open(os.path.join(env.ctx_dir, "Dockerfile")) as f:
+            m = env.build(f.read(), tag=f"examples/{name}:1",
+                          modify_fs=modify_fs)
+        assert m.layers, name
+
+
+def test_history_has_empty_layer_entries(env):
+    env.file("f", "f")
+    m = env.build("FROM scratch\nCOPY f /f\nLABEL a=b\nCMD [\"x\"]\n")
+    cfg = env.config(m)
+    layer_entries = [h for h in cfg.history if not h.empty_layer]
+    empty_entries = [h for h in cfg.history if h.empty_layer]
+    assert len(layer_entries) == len(cfg.rootfs.diff_ids)
+    assert empty_entries  # LABEL/CMD recorded as empty-layer history
