@@ -154,22 +154,41 @@ class ChunkSession:
     def _dispatch_block(self, blk: bytes, live: int | None = None) -> None:
         """Ship one block to the device (async); process the oldest
         in-flight block when the pipeline is full."""
+        from makisu_tpu.ops import gear_pallas
         live = len(blk) if live is None else live
         halo = self._halo
         buf = np.frombuffer(halo + blk, dtype=np.uint8)
-        words = gear.gear_bitmap(buf, self.avg_bits)  # async dispatch
-        self._inflight.append((words, len(halo), live, blk, self._scanned))
+        if gear_pallas.pallas_enabled():
+            # Experimental fused kernel (MAKISU_TPU_PALLAS=1): same async
+            # dispatch, row-staged input, packed bitmap out.
+            rows, nrows = gear_pallas.stage_rows(buf, len(halo), live)
+            words = gear_pallas.gear_bitmap_rows(
+                rows, self.avg_bits,
+                interpret=__import__("jax").default_backend() == "cpu")
+            entry = ("pallas", words, nrows, live, blk, self._scanned)
+        else:
+            words = gear.gear_bitmap(buf, self.avg_bits)  # async dispatch
+            entry = ("xla", words, len(halo), live, blk, self._scanned)
+        self._inflight.append(entry)
         self._scanned += live
-        self._halo = (halo + blk)[-(gear.WINDOW):]
+        self._halo = (halo + blk)[-(gear_pallas.HALO):]
         while len(self._inflight) > self.PIPELINE_DEPTH:
             self._process_block(self._inflight.pop(0))
 
     def _process_block(self, entry: tuple) -> None:
         """Read back one block's bitmap (sync) and cut chunks."""
-        words, halo_len, live, blk, base = entry
+        kind, words, meta, live, blk, base = entry
         host_words = np.asarray(words)
-        bits = gear.unpack_bits_np(
-            host_words, halo_len + live)[halo_len:halo_len + live]
+        if kind == "pallas":
+            from makisu_tpu.ops import gear_pallas
+            nrows = meta
+            bits = gear.unpack_bits_np(
+                host_words[:nrows], nrows * gear_pallas.ROW)
+            bits = bits.reshape(-1)[:live]
+        else:
+            halo_len = meta
+            bits = gear.unpack_bits_np(
+                host_words, halo_len + live)[halo_len:halo_len + live]
         candidates = np.nonzero(bits)[0] + base
         self._tail.extend(blk[:live])
         for pos in candidates:

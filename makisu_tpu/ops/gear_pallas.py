@@ -1,0 +1,112 @@
+"""Experimental Pallas TPU kernel for the fused Gear scan.
+
+The XLA path (ops/gear.py) materializes the uint32 hash array between the
+log-doubling steps; this kernel keeps everything — splitmix table values,
+the 5 shifted-add steps, the mask compare, and the bit-pack — inside one
+VMEM-resident kernel, writing only the packed bitmap (3% of input bytes)
+back to HBM.
+
+Formulation: the stream is restaged into overlapping rows
+``rows[r] = stream[r*C - H : r*C + C]`` (halo H = 128 bytes, left-padded
+with zeros at the stream head). Each row is then independent: position
+hashes read at most 31 predecessor bytes, all inside the row buffer. The
+zero-padding at the stream head makes positions < 31 differ from true
+zero-history hashes, but those sit far below the minimum chunk size and
+can never become cuts, so selected chunks are identical (asserted in
+tests against the XLA path).
+
+Status: validated in Pallas interpret mode (CPU); opt-in on hardware via
+MAKISU_TPU_PALLAS=1 until profiled on a real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from makisu_tpu.ops import gear
+
+HALO = 128            # row overlap; must be >= gear.WINDOW and % 128 == 0
+ROW = 8192            # live bytes per row (64 lanes of 128)
+ROW_TILE = 32         # rows per grid step (uint8 sublane tile)
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("MAKISU_TPU_PALLAS", "") == "1"
+
+
+def stage_rows(buf: np.ndarray, start: int, n: int) -> tuple[np.ndarray, int]:
+    """Restage ``buf[start:start+n]`` into overlapping halo rows.
+
+    Returns (rows [R, HALO+ROW] uint8, R) with R padded to a multiple of
+    ROW_TILE; positions beyond ``n`` are zero-filled (callers mask the
+    bitmap tail).
+    """
+    nrows = max((n + ROW - 1) // ROW, 1)
+    nrows_padded = ((nrows + ROW_TILE - 1) // ROW_TILE) * ROW_TILE
+    rows = np.zeros((nrows_padded, HALO + ROW), dtype=np.uint8)
+    for r in range(nrows):
+        lo = start + r * ROW - HALO
+        hi = min(start + r * ROW + ROW, start + n)
+        dst_off = 0
+        if lo < 0:
+            dst_off = -lo
+            lo = 0
+        seg = buf[lo:hi]
+        rows[r, dst_off:dst_off + len(seg)] = seg
+    return rows, nrows
+
+
+def _gear_kernel(avg_bits: int, rows_ref, out_ref) -> None:
+    d = rows_ref[:]                                   # [T, HALO+ROW] uint8
+    h = gear._gear_value(d)                           # splitmix chain, VPU
+    m = 1
+    while m < gear.WINDOW:
+        shifted = jnp.pad(h, ((0, 0), (m, 0)))[:, :-m]
+        h = h + (shifted << jnp.uint32(m))
+        m *= 2
+    live = h[:, HALO:]                                # [T, ROW]
+    mask = (live & jnp.uint32((1 << avg_bits) - 1)) == 0
+    b = mask.reshape(mask.shape[0], ROW // 32, 32).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jax.lax.broadcasted_iota(
+        jnp.uint32, (1, 1, 32), 2)
+    out_ref[:] = jnp.sum(b * weights, axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))
+def gear_bitmap_rows(rows: jax.Array,
+                     avg_bits: int = gear.DEFAULT_AVG_BITS,
+                     interpret: bool = False) -> jax.Array:
+    """uint8 rows [R, HALO+ROW] → packed candidate bitmap [R, ROW//32]."""
+    from jax.experimental import pallas as pl
+
+    R = rows.shape[0]
+    if R % ROW_TILE or rows.shape[1] != HALO + ROW:
+        raise ValueError(f"bad row staging shape {rows.shape}")
+    kernel = functools.partial(_gear_kernel, avg_bits)
+    return pl.pallas_call(
+        kernel,
+        grid=(R // ROW_TILE,),
+        in_specs=[pl.BlockSpec((ROW_TILE, HALO + ROW), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((ROW_TILE, ROW // 32), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, ROW // 32), jnp.uint32),
+        interpret=interpret,
+    )(rows)
+
+
+def gear_candidates(buf: np.ndarray, start: int, n: int,
+                    avg_bits: int = gear.DEFAULT_AVG_BITS,
+                    interpret: bool | None = None) -> np.ndarray:
+    """Candidate cut positions (relative to ``start``) for
+    ``buf[start:start+n]`` via the Pallas kernel."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    rows, nrows = stage_rows(buf, start, n)
+    words = np.asarray(gear_bitmap_rows(rows, avg_bits, interpret))
+    bits = gear.unpack_bits_np(words[:nrows], nrows * ROW)
+    flat = bits.reshape(-1)[:n]
+    return np.nonzero(flat)[0]

@@ -1,0 +1,78 @@
+"""Pallas gear kernel: interpret-mode equivalence with the XLA path."""
+
+import numpy as np
+import pytest
+
+from makisu_tpu.ops import gear, gear_pallas
+
+
+def candidates_xla(data: bytes) -> np.ndarray:
+    """Reference: candidate positions from the XLA path, restricted to
+    the window-complete region (>= WINDOW) to match the kernel's
+    zero-pad-at-head semantics; below-min-size positions are irrelevant
+    to chunking either way."""
+    import jax.numpy as jnp
+    arr = np.frombuffer(data, dtype=np.uint8)
+    pad = (-len(arr)) % 32
+    h = np.asarray(gear.gear_hash(jnp.asarray(
+        np.concatenate([arr, np.zeros(pad, np.uint8)]))))[:len(arr)]
+    mask = (h & ((1 << gear.DEFAULT_AVG_BITS) - 1)) == 0
+    return np.nonzero(mask)[0]
+
+
+@pytest.mark.parametrize("n", [1000, gear_pallas.ROW,
+                               3 * gear_pallas.ROW + 777,
+                               40 * gear_pallas.ROW])
+def test_pallas_candidates_match_xla(n):
+    buf = np.random.default_rng(n).integers(0, 256, size=n, dtype=np.uint8)
+    got = set(gear_pallas.gear_candidates(buf, 0, n, interpret=True))
+    want = set(candidates_xla(buf.tobytes()))
+    # Positions below WINDOW may differ (zero-pad vs zero-history); both
+    # sit far under the minimum chunk size and never become cuts.
+    got = {p for p in got if p >= gear.WINDOW}
+    want = {p for p in want if p >= gear.WINDOW}
+    assert got == want
+
+
+def test_pallas_with_offset_window():
+    buf = np.random.default_rng(9).integers(
+        0, 256, size=30_000, dtype=np.uint8)
+    start, n = 5_000, 20_000
+    got = set(gear_pallas.gear_candidates(buf, start, n, interpret=True))
+    # Reference over the same window WITH its true 128-byte history.
+    import jax.numpy as jnp
+    h = np.asarray(gear.gear_hash(jnp.asarray(
+        buf[start - 128:start + n])))[128:]
+    want = set(np.nonzero(
+        (h & ((1 << gear.DEFAULT_AVG_BITS) - 1)) == 0)[0])
+    assert got == want
+
+
+def test_stage_rows_shapes():
+    buf = np.arange(20_000, dtype=np.uint32).astype(np.uint8)
+    rows, nrows = gear_pallas.stage_rows(buf, 0, len(buf))
+    assert rows.shape[1] == gear_pallas.HALO + gear_pallas.ROW
+    assert rows.shape[0] % gear_pallas.ROW_TILE == 0
+    assert nrows == (len(buf) + gear_pallas.ROW - 1) // gear_pallas.ROW
+    # Row 1's halo equals the last HALO bytes before its live region.
+    np.testing.assert_array_equal(
+        rows[1, :gear_pallas.HALO],
+        buf[gear_pallas.ROW - gear_pallas.HALO:gear_pallas.ROW])
+
+
+def test_chunk_session_pallas_path_matches(monkeypatch):
+    """MAKISU_TPU_PALLAS=1 must produce identical chunks end to end."""
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    payload = np.random.default_rng(42).integers(
+        0, 256, size=500_000, dtype=np.uint8).tobytes()
+
+    def run():
+        s = ChunkSession(block=128 * 1024)
+        for i in range(0, len(payload), 50_000):
+            s.update(payload[i:i + 50_000])
+        return [(c.offset, c.length, c.digest) for c in s.finish()]
+
+    baseline = run()
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    assert run() == baseline
