@@ -301,15 +301,28 @@ def cmd_diff(args) -> int:
         update_global_config(args.registry_config)
     with ImageStore(_storage_dir(args.storage)) as store:
         trees = []
+        configs = []
         for image in args.images:
             name = ImageName.parse_for_pull(image)
             manifest = new_client(store, name).pull(name)
+            with store.layers.open(manifest.config.digest.hex()) as f:
+                import json as json_mod
+
+                configs.append(json_mod.load(f))
             root = tempfile.mkdtemp(dir=store.sandbox_dir)
             fs = MemFS(root, blacklist=[])
             for desc in manifest.layers:
                 fs.update_from_tar_path(
                     store.layers.path(desc.digest.hex()), untar=False)
             trees.append(fs)
+        # Config diff first (reference: cmd/diff.go go-cmp over configs).
+        c1, c2 = configs
+        for key in sorted(set(c1.get("config", {})) |
+                          set(c2.get("config", {}))):
+            v1 = c1.get("config", {}).get(key)
+            v2 = c2.get("config", {}).get(key)
+            if v1 != v2:
+                print(f"config {key}: {v1!r} != {v2!r}")
         diff = trees[0].compare(trees[1],
                                 ignore_mtime=args.ignore_modtime)
         for p in diff.missing_in_first:

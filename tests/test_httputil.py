@@ -1,0 +1,82 @@
+"""httputil.send retry/backoff/fallback unit tests (reference:
+lib/utils/httputil)."""
+
+import pytest
+
+from makisu_tpu.utils.httputil import (
+    HTTPError,
+    NetworkError,
+    Response,
+    send,
+)
+
+
+class StubTransport:
+    """Scripted responses; NetworkError entries raise."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def round_trip(self, method, url, headers, body=None, timeout=60.0):
+        self.calls.append((method, url))
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def test_success_first_try():
+    t = StubTransport([Response(200, {}, b"ok")])
+    assert send(t, "GET", "https://x/y").body == b"ok"
+    assert len(t.calls) == 1
+
+
+def test_retry_on_503_then_success():
+    t = StubTransport([Response(503, {}, b""), Response(200, {}, b"ok")])
+    assert send(t, "GET", "https://x/y", backoff=0.01).body == b"ok"
+    assert len(t.calls) == 2
+
+
+def test_no_retry_on_404():
+    t = StubTransport([Response(404, {}, b"gone")])
+    with pytest.raises(HTTPError) as e:
+        send(t, "GET", "https://x/y", backoff=0.01)
+    assert e.value.status == 404
+    assert len(t.calls) == 1
+
+
+def test_retryable_exhaustion_raises_http_error():
+    t = StubTransport([Response(503, {}, b"")] * 3)
+    with pytest.raises(HTTPError) as e:
+        send(t, "GET", "https://x/y", retries=3, backoff=0.01)
+    assert e.value.status == 503
+
+
+def test_network_error_retries_then_raises():
+    t = StubTransport([NetworkError("boom")] * 3)
+    with pytest.raises(NetworkError):
+        send(t, "GET", "https://x/y", retries=3, backoff=0.01)
+    assert len(t.calls) == 3
+
+
+def test_https_fallback_to_http():
+    t = StubTransport([NetworkError("tls refused"),
+                       Response(200, {}, b"plain")])
+    resp = send(t, "GET", "https://reg.local/v2/", backoff=0.01,
+                allow_http_fallback=True)
+    assert resp.body == b"plain"
+    assert t.calls[1][1].startswith("http://")
+
+
+def test_no_fallback_without_flag():
+    t = StubTransport([NetworkError("x")] * 2 + [Response(200, {}, b"")])
+    send(t, "GET", "https://reg.local/v2/", backoff=0.01, retries=3)
+    # All attempts stayed https.
+    assert all(u.startswith("https://") for _, u in t.calls)
+
+
+def test_custom_accepted_codes():
+    t = StubTransport([Response(202, {"location": "/next"}, b"")])
+    resp = send(t, "POST", "https://x/upload", accepted=(202,))
+    assert resp.header("Location") == "/next"
