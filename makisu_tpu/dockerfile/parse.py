@@ -92,6 +92,7 @@ def parse_file(contents: str, build_args: dict[str, str] | None = None,
     ``build_args`` are the caller's ``--build-arg`` values, consulted when
     ARG directives declare matching names.
     """
+    contents = contents.replace("\r\n", "\n")  # CRLF Dockerfiles
     # Full-line comments go first so a trailing "\" on a comment line does
     # not join it with the next line; then continuations are spliced.
     kept = [l for l in contents.split("\n") if l.strip(" \t")

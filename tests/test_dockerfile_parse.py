@@ -235,3 +235,70 @@ def test_multistage_copy_from_chain():
     assert stages[0].from_directive.image == "alpine"
     copy = stages[1].directives[0]
     assert isinstance(copy, CopyDirective) and copy.from_stage == "build"
+
+
+def test_crlf_dockerfile():
+    stage = parse1("FROM alpine\r\nENV A=1\r\nRUN echo hi\r\n")
+    assert stage.from_directive.image == "alpine"
+    assert stage.directives[0].envs == {"A": "1"}
+    assert stage.directives[1].cmd == "echo hi"
+
+
+FULL_FIXTURE = """\
+# syntax-style comment
+ARG  REGISTRY=index.docker.io
+ARG  TAG=3.11
+FROM ${REGISTRY}/library/python:${TAG} AS deps
+WORKDIR /install
+COPY requirements.txt .
+RUN pip install --prefix=/install -r requirements.txt #!COMMIT
+
+FROM scratch AS assets
+COPY web/dist /assets/
+
+FROM ${REGISTRY}/library/python:${TAG}-slim
+LABEL org.opencontainers.image.title="demo" \\
+      org.opencontainers.image.vendor="makisu-tpu"
+ENV PYTHONPATH=/install/lib \\
+    PORT=8000
+COPY --from=deps /install /usr/local/
+COPY --from=assets --chown=33:33 /assets /srv/www/
+COPY app /app/
+EXPOSE ${PORT} 9090/udp
+VOLUME ["/data", "/logs"]
+HEALTHCHECK --interval=1m30s --timeout=10s --start-period=5s --retries=3 \\
+  CMD curl -fsS http://localhost:${PORT}/healthz || exit 1
+USER 33
+WORKDIR /app
+STOPSIGNAL 15
+ENTRYPOINT ["python", "-m", "app"]
+CMD ["--serve"]
+"""
+
+
+def test_full_fixture_dockerfile():
+    stages = parse_file(FULL_FIXTURE, {"TAG": "3.12"})
+    assert [s.alias for s in stages] == ["deps", "assets", ""]
+    assert stages[0].from_directive.image == \
+        "index.docker.io/library/python:3.12"
+    assert stages[2].from_directive.image == \
+        "index.docker.io/library/python:3.12-slim"
+    run = stages[0].directives[2]
+    assert isinstance(run, RunDirective) and run.commit
+    final = {type(d).__name__: d for d in stages[2].directives}
+    assert final["LabelDirective"].labels == {
+        "org.opencontainers.image.title": "demo",
+        "org.opencontainers.image.vendor": "makisu-tpu"}
+    assert final["EnvDirective"].envs == {
+        "PYTHONPATH": "/install/lib", "PORT": "8000"}
+    copies = [d for d in stages[2].directives
+              if isinstance(d, CopyDirective)]
+    assert copies[0].from_stage == "deps"
+    assert copies[1].from_stage == "assets" and copies[1].chown == "33:33"
+    assert final["ExposeDirective"].ports == ["8000", "9090/udp"]
+    hc = final["HealthcheckDirective"]
+    assert hc.interval == 90 * 10**9 and hc.retries == 3
+    assert "healthz" in hc.test[1]
+    assert final["StopsignalDirective"].signal == 15
+    assert final["EntrypointDirective"].entrypoint == ["python", "-m", "app"]
+    assert final["CmdDirective"].cmd == ["--serve"]

@@ -1,0 +1,86 @@
+"""Property-based fuzzing of the Dockerfile text grammars.
+
+The parser fronts untrusted input (Dockerfiles from any repo); the
+invariant under fuzz is "parse cleanly or raise the typed error" — never
+crash with an internal exception, never loop.
+"""
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from makisu_tpu.dockerfile import (
+    TextParseError,
+    parse_file,
+    parse_key_vals,
+    replace_variables,
+    split_args,
+)
+
+TEXT = st.text(
+    alphabet=string.ascii_letters + string.digits + " \t\"'\\${}:-+=#&|;./\n",
+    max_size=120)
+VARS = st.dictionaries(
+    st.text(string.ascii_lowercase, min_size=1, max_size=5),
+    st.text(string.ascii_letters + "$\\{}", max_size=10), max_size=4)
+
+
+@settings(max_examples=300, deadline=None)
+@given(TEXT, VARS)
+def test_replace_variables_total(text, variables):
+    try:
+        out = replace_variables(text.replace("\n", " "), variables)
+        assert isinstance(out, str)
+    except TextParseError:
+        pass
+
+
+@settings(max_examples=300, deadline=None)
+@given(TEXT)
+def test_split_args_total(text):
+    for for_shell in (False, True):
+        try:
+            out = split_args(text.replace("\n", " "), for_shell)
+            assert all(isinstance(t, str) for t in out)
+        except TextParseError:
+            pass
+
+
+@settings(max_examples=300, deadline=None)
+@given(TEXT)
+def test_parse_key_vals_total(text):
+    try:
+        out = parse_key_vals(text.replace("\n", " "))
+        assert all("=" not in k for k in out)
+    except TextParseError:
+        pass
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(TEXT, max_size=6), VARS)
+def test_parse_file_total(lines, build_args):
+    contents = "FROM scratch\n" + "\n".join(lines)
+    try:
+        stages = parse_file(contents, build_args)
+        assert stages
+    except (ValueError, TextParseError):
+        pass  # typed rejection is fine; crashes are not
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=2000))
+def test_chunk_policy_covers_any_stream(data):
+    """Greedy cut selection is total and exactly covers any stream."""
+    import numpy as np
+
+    from makisu_tpu.ops.gear import select_boundaries_np
+    rng = np.random.default_rng(len(data))
+    n = len(data)
+    cand = np.sort(rng.choice(max(n, 1), size=min(n // 7, 50),
+                              replace=False)) if n else np.array([], int)
+    cuts = select_boundaries_np(cand, n, min_size=16, max_size=256)
+    assert cuts[-1] == n
+    prev = 0
+    for c in cuts[:-1]:
+        assert 0 < c - prev <= 256
+        prev = c
