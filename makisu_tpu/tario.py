@@ -138,6 +138,11 @@ def write_entry(tw: tarfile.TarFile, src: str, h: tarfile.TarInfo) -> None:
 
 
 def untar(tf: tarfile.TarFile, dest: str) -> None:
-    """Plain untar into dest (no whiteout handling; reference untar.go:33)."""
+    """Plain untar into dest (no whiteout handling; reference untar.go:33).
+
+    Uses the stdlib "tar" extraction filter: absolute names and
+    parent-escaping paths in hostile tars are rejected rather than
+    written outside ``dest``.
+    """
     for member in tf:
-        tf.extract(member, dest, set_attrs=True)
+        tf.extract(member, dest, filter="tar")
