@@ -113,6 +113,10 @@ def make_parser() -> argparse.ArgumentParser:
     diff.add_argument("--storage", default="")
     diff.add_argument("--registry-config", default="")
 
+    worker = sub.add_parser("worker", help="run a long-lived build worker")
+    worker.add_argument("--socket", default="/tmp/makisu-tpu-worker.sock",
+                        help="unix socket to listen on")
+
     sub.add_parser("version", help="print the build version")
     return parser
 
@@ -336,6 +340,19 @@ def cmd_diff(args) -> int:
     return 0
 
 
+def cmd_worker(args) -> int:
+    from makisu_tpu.worker import WorkerServer
+    server = WorkerServer(args.socket)
+    log.info("worker listening on %s", args.socket)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
@@ -345,7 +362,7 @@ def main(argv: list[str] | None = None) -> int:
         print(makisu_tpu.BUILD_HASH)
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
-                "diff": cmd_diff}
+                "diff": cmd_diff, "worker": cmd_worker}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()

@@ -49,6 +49,7 @@ class WorkerClient:
         try:
             conn, resp = self._request("GET", "/ready")
             try:
+                resp.read()
                 return resp.status == 200
             finally:
                 conn.close()
@@ -57,7 +58,10 @@ class WorkerClient:
 
     def exit(self) -> None:
         conn, resp = self._request("GET", "/exit")
-        conn.close()
+        try:
+            resp.read()
+        finally:
+            conn.close()
 
     def prepare_context(self, context_dir: str) -> str:
         """Copy the build context into the shared mount and return the

@@ -29,9 +29,11 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/ready":
             self._respond(200, b"ok")
         elif self.path == "/exit":
-            self._respond(200, b"bye")
+            # Shut down regardless of whether the response write lands
+            # (clients may hang up as soon as the status line arrives).
             threading.Thread(target=self.server.shutdown,
                              daemon=True).start()
+            self._respond(200, b"bye")
         else:
             self._respond(404, b"not found")
 
@@ -59,10 +61,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"0\r\n\r\n")
 
     def _respond(self, status: int, body: bytes) -> None:
-        self.send_response(status)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up; not our problem
 
 
 class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):

@@ -70,3 +70,27 @@ def test_prepare_context_copies_into_shared(tmp_path, worker):
     worker_path = client.prepare_context(str(ctx))
     assert worker_path == "/mnt/shared/myctx"
     assert (shared / "myctx" / "f").read_text() == "x"
+
+
+def test_worker_cli_subcommand(tmp_path):
+    """`makisu-tpu worker --socket ...` serves builds end to end."""
+    import subprocess
+    import sys
+    import time
+
+    sock = str(tmp_path / "cliworker.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "makisu_tpu.cli", "worker",
+         "--socket", sock],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = WorkerClient(sock)
+        for _ in range(100):
+            if client.ready():
+                break
+            time.sleep(0.1)
+        assert client.ready()
+        client.exit()
+        proc.wait(timeout=10)
+    finally:
+        proc.kill()
