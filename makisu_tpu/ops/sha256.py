@@ -25,6 +25,7 @@ Layout choices (TPU-first):
 from __future__ import annotations
 
 import functools
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -119,8 +120,10 @@ def bytes_to_words(msg: jax.Array) -> jax.Array:
 # parallelism — but a fully unrolled body (64 rounds x ~30 uint32 ops, plus
 # the message schedule) produces an HLO graph XLA takes minutes to compile
 # on a small host. A rolled lax.scan with modest unroll compiles in seconds
-# and runs the same VPU work per round.
-ROUND_UNROLL = 4
+# and runs the same VPU work per round. Tunable per deployment
+# (MAKISU_TPU_SHA_UNROLL) — on real TPU toolchains higher unrolls trade
+# compile time for lower loop overhead.
+ROUND_UNROLL = int(_os.environ.get("MAKISU_TPU_SHA_UNROLL", "4"))
 
 
 def _compress(state, w16):

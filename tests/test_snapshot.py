@@ -377,3 +377,43 @@ def test_compare_trees(tmp_path):
     assert "/only1" in diff.missing_in_second
     assert "/only2" in diff.missing_in_first
     assert not any(p == "/same" for p, _, _ in diff.different)
+
+
+def test_hardlinked_files_scan_as_regular(tmp_path):
+    """Scan layers record hardlinks as independent regular files (the
+    reference does the same: createHeader's hardlink TODO); content must
+    be intact for both names."""
+    (tmp_path / "orig").write_bytes(b"shared-bytes")
+    os.link(tmp_path / "orig", tmp_path / "alias")
+    fs = new_fs(tmp_path)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w|") as tw:
+        fs.add_layer_by_scan(tw)
+    buf.seek(0)
+    with tarfile.open(fileobj=buf, mode="r|") as tr:
+        members = {m.name: (m, tr.extractfile(m).read() if m.isreg()
+                            else None) for m in tr}
+    assert members["orig"][0].isreg() and members["alias"][0].isreg()
+    assert members["orig"][1] == members["alias"][1] == b"shared-bytes"
+
+
+def test_long_paths_roundtrip(tmp_path):
+    """>100-char paths need PAX/GNU extensions; scan + merge must agree."""
+    deep = tmp_path
+    for i in range(12):
+        deep = deep / f"directory-level-{i:02d}-with-a-long-name"
+    deep.mkdir(parents=True)
+    f = deep / ("f" * 60 + ".txt")
+    f.write_text("deep")
+    fs = new_fs(tmp_path)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w|") as tw:
+        fs.add_layer_by_scan(tw)
+    buf.seek(0)
+    dest = tmp_path.parent / (tmp_path.name + "-restored")
+    dest.mkdir()
+    fs2 = new_fs(dest)
+    with tarfile.open(fileobj=buf, mode="r|") as tf:
+        fs2.update_from_tar(tf, untar=True)
+    restored = str(f).replace(str(tmp_path), str(dest))
+    assert open(restored).read() == "deep"
