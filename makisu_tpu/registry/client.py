@@ -133,19 +133,22 @@ class RegistryClient:
     def _send(self, method: str, url: str,
               headers: dict[str, str] | None = None,
               body: bytes | None = None,
-              accepted: tuple[int, ...] = (200,)) -> Response:
+              accepted: tuple[int, ...] = (200,),
+              stream_to: str | None = None) -> Response:
         try:
             return send(self.transport, method, url, self._headers(headers),
                         body, accepted, retries=self.config.retries,
                         timeout=self.config.timeout,
                         allow_http_fallback=not
-                        self.config.security.tls_verify)
+                        self.config.security.tls_verify,
+                        stream_to=stream_to)
         except HTTPError as e:
             if e.status == 401 and self._authenticate(e):
                 return send(self.transport, method, url,
                             self._headers(headers), body, accepted,
                             retries=self.config.retries,
-                            timeout=self.config.timeout)
+                            timeout=self.config.timeout,
+                            stream_to=stream_to)
             raise
 
     def _authenticate(self, err: HTTPError) -> bool:
@@ -209,17 +212,31 @@ class RegistryClient:
         return manifest
 
     def pull_layer(self, digest: Digest) -> str:
-        """Download one blob into the CAS store (no-op if present)."""
+        """Download one blob into the CAS store (no-op if present).
+
+        The body streams to a sandbox file in 1MiB chunks — layer blobs
+        can be multi-GB (reference pullLayerHelper:301-362 also streams
+        to a download file before committing to the CAS)."""
+        import tempfile
         hex_digest = Digest(digest).hex()
         if self.store.layers.exists(hex_digest):
             return self.store.layers.path(hex_digest)
-        resp = self._send("GET", f"{self._base()}/blobs/{digest}",
-                          accepted=(200, 307))
-        if resp.status == 307:
-            resp = send(self.transport, "GET", resp.header("location"), {},
-                        retries=self.config.retries,
-                        timeout=self.config.timeout)
-        return self.store.layers.write_bytes(hex_digest, resp.body)
+        fd, tmp = tempfile.mkstemp(prefix="blob-")
+        os.close(fd)
+        try:
+            resp = self._send("GET", f"{self._base()}/blobs/{digest}",
+                              accepted=(200, 307), stream_to=tmp)
+            if resp.status == 307:
+                send(self.transport, "GET", resp.header("location"), {},
+                     retries=self.config.retries,
+                     timeout=self.config.timeout, stream_to=tmp)
+            if resp.body:
+                # Transport without streaming support (fixtures).
+                with open(tmp, "wb") as f:
+                    f.write(resp.body)
+            return self.store.layers.link_file(hex_digest, tmp)
+        finally:
+            os.unlink(tmp)
 
     def pull_image_config(self, digest: Digest) -> bytes:
         path = self.pull_layer(digest)
@@ -283,28 +300,26 @@ class RegistryClient:
             location = base + location
         chunk = self.config.push_chunk
         path = self.store.layers.path(digest.hex())
+        size = os.path.getsize(path)
+        step = size if (chunk <= 0 or chunk >= size) else chunk
         with open(path, "rb") as f:
-            data = f.read()
-        if chunk <= 0 or chunk >= len(data):
-            pieces = [(0, data)] if data else []
-        else:
-            pieces = [(off, data[off:off + chunk])
-                      for off in range(0, len(data), chunk)]
-        for off, piece in pieces:
-            self._limiter.wait(len(piece))
-            sep = "&" if "?" in location else "?"
-            resp = self._send(
-                "PATCH", location,
-                headers={
-                    "Content-Type": "application/octet-stream",
-                    "Content-Range": f"{off}-{off + len(piece) - 1}",
-                    "Content-Length": str(len(piece)),
-                },
-                body=piece, accepted=(202,))
-            location = resp.header("location") or location
-            if not location.startswith("http"):
-                base = self._base().split("/v2/")[0]
-                location = base + location
+            off = 0
+            while off < size:
+                piece = f.read(step)  # one chunk resident at a time
+                self._limiter.wait(len(piece))
+                resp = self._send(
+                    "PATCH", location,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "Content-Range": f"{off}-{off + len(piece) - 1}",
+                        "Content-Length": str(len(piece)),
+                    },
+                    body=piece, accepted=(202,))
+                off += len(piece)
+                location = resp.header("location") or location
+                if not location.startswith("http"):
+                    base = self._base().split("/v2/")[0]
+                    location = base + location
         sep = "&" if "?" in location else "?"
         self._send("PUT", f"{location}{sep}digest={digest}",
                    accepted=(201, 204))

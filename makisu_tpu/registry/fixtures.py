@@ -93,7 +93,8 @@ class RegistryFixture(Transport):
 
     # -- transport --------------------------------------------------------
 
-    def round_trip(self, method, url, headers, body=None, timeout=60.0):
+    def round_trip(self, method, url, headers, body=None, timeout=60.0,
+                   stream_to=None):  # fixtures return bytes directly
         self.requests.append((method, url))
         for i, (m, pattern, resp) in enumerate(self.overrides):
             if m == method and re.search(pattern, url):

@@ -67,7 +67,11 @@ class Transport:
 
     def round_trip(self, method: str, url: str, headers: dict[str, str],
                    body: bytes | BinaryIO | None = None,
-                   timeout: float = 60.0) -> Response:
+                   timeout: float = 60.0,
+                   stream_to: str | None = None) -> Response:
+        """One exchange. With ``stream_to`` set, a 2xx body streams to
+        that file path in 1MiB chunks (Response.body stays empty) so
+        multi-GB blobs never materialize in memory."""
         if hasattr(body, "read"):
             body = body.read()
         req = urllib.request.Request(url, data=body, method=method,
@@ -77,9 +81,17 @@ class Transport:
             _NoRedirect())
         try:
             with opener.open(req, timeout=timeout) as resp:
-                return Response(resp.status,
-                                {k.lower(): v for k, v in resp.headers.items()},
-                                resp.read())
+                resp_headers = {k.lower(): v
+                                for k, v in resp.headers.items()}
+                if stream_to is not None and resp.status // 100 == 2:
+                    with open(stream_to, "wb") as out:
+                        while True:
+                            chunk = resp.read(1 << 20)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+                    return Response(resp.status, resp_headers, b"")
+                return Response(resp.status, resp_headers, resp.read())
         except urllib.error.HTTPError as e:
             data = e.read() if hasattr(e, "read") else b""
             return Response(e.code,
@@ -102,14 +114,17 @@ def send(transport: Transport, method: str, url: str,
          accepted: tuple[int, ...] = (200,),
          retries: int = 3, backoff: float = 0.5,
          timeout: float = 60.0,
-         allow_http_fallback: bool = False) -> Response:
+         allow_http_fallback: bool = False,
+         stream_to: str | None = None) -> Response:
     """One request with retry/backoff on retryable statuses and network
     errors, optional https→http downgrade for plain-HTTP registries."""
     headers = dict(headers or {})
     last: Exception | None = None
     for attempt in range(retries):
         try:
-            resp = transport.round_trip(method, url, headers, body, timeout)
+            kwargs = {} if stream_to is None else {"stream_to": stream_to}
+            resp = transport.round_trip(method, url, headers, body, timeout,
+                                        **kwargs)
         except NetworkError as e:
             last = e
             if allow_http_fallback and url.startswith("https://"):
