@@ -23,6 +23,12 @@ MEDIA_TYPE_MANIFEST = "application/vnd.docker.distribution.manifest.v2+json"
 MEDIA_TYPE_CONFIG = "application/vnd.docker.container.image.v1+json"
 MEDIA_TYPE_LAYER = "application/vnd.docker.image.rootfs.diff.tar.gzip"
 
+# OCI image-spec equivalents: accepted on pull (the reference is
+# docker-schema2-only); we always produce docker types on push.
+MEDIA_TYPE_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
+MEDIA_TYPE_OCI_CONFIG = "application/vnd.oci.image.config.v1+json"
+MEDIA_TYPE_OCI_LAYER = "application/vnd.oci.image.layer.v1.tar+gzip"
+
 # sha256 of the empty gzipped tar; docker uses it for no-op layers.
 DIGEST_EMPTY_TAR = (
     "sha256:84ff92691f909a05b224e1c56abb4864f01b4f8e3c854e4bb4c7baf1d3f6d652"

@@ -201,9 +201,11 @@ class RegistryClient:
         return manifest
 
     def pull_manifest(self, tag: str) -> DistributionManifest:
+        from makisu_tpu.docker.image import MEDIA_TYPE_OCI_MANIFEST
         resp = self._send(
             "GET", f"{self._base()}/manifests/{tag}",
-            headers={"Accept": MEDIA_TYPE_MANIFEST})
+            headers={"Accept":
+                     f"{MEDIA_TYPE_MANIFEST}, {MEDIA_TYPE_OCI_MANIFEST}"})
         manifest = DistributionManifest.from_bytes(resp.body)
         if manifest.schema_version != 2:
             raise ValueError(

@@ -148,3 +148,27 @@ def test_basic_auth_header_sent(store, fixture):
     c.pull_manifest("v8")
     import base64
     assert seen["auth"] == "Basic " + base64.b64encode(b"u:p").decode()
+
+
+def test_pull_oci_manifest(store, fixture):
+    """OCI-typed manifests (schema2-compatible layout) pull fine."""
+    import json as json_mod
+
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_OCI_CONFIG,
+        MEDIA_TYPE_OCI_LAYER,
+        MEDIA_TYPE_OCI_MANIFEST,
+    )
+    manifest, config_blob, blobs = make_test_image()
+    raw = json_mod.loads(manifest.to_bytes())
+    raw["mediaType"] = MEDIA_TYPE_OCI_MANIFEST
+    raw["config"]["mediaType"] = MEDIA_TYPE_OCI_CONFIG
+    for layer in raw["layers"]:
+        layer["mediaType"] = MEDIA_TYPE_OCI_LAYER
+    fixture.manifests["team/app:oci"] = json_mod.dumps(raw).encode()
+    fixture.blobs.update(blobs)
+    c = client(store, fixture)
+    pulled = c.pull(ImageName("registry.test", "team/app", "oci"))
+    assert len(pulled.layers) == 1
+    for digest in [pulled.config.digest] + pulled.layer_digests():
+        assert store.layers.exists(digest.hex())
