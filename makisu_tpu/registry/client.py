@@ -22,6 +22,9 @@ from makisu_tpu.docker.image import (
     MEDIA_TYPE_CONFIG,
     MEDIA_TYPE_LAYER,
     MEDIA_TYPE_MANIFEST,
+    MEDIA_TYPE_OCI_CONFIG,
+    MEDIA_TYPE_OCI_LAYER,
+    MEDIA_TYPE_OCI_MANIFEST,
     Digest,
     DistributionManifest,
     ImageName,
@@ -201,7 +204,6 @@ class RegistryClient:
         return manifest
 
     def pull_manifest(self, tag: str) -> DistributionManifest:
-        from makisu_tpu.docker.image import MEDIA_TYPE_OCI_MANIFEST
         resp = self._send(
             "GET", f"{self._base()}/manifests/{tag}",
             headers={"Accept":
@@ -211,7 +213,45 @@ class RegistryClient:
             raise ValueError(
                 f"unsupported manifest schema {manifest.schema_version} "
                 f"(only schema2 is supported)")
-        return manifest
+        if manifest.media_type not in (MEDIA_TYPE_MANIFEST,
+                                       MEDIA_TYPE_OCI_MANIFEST):
+            raise ValueError(
+                f"unsupported manifest type {manifest.media_type!r} "
+                "(multi-arch indexes/manifest lists are not supported; "
+                "pull a platform-specific tag or digest)")
+        if manifest.config is None:
+            raise ValueError("manifest has no config descriptor")
+        return self._normalize_manifest(manifest)
+
+    @staticmethod
+    def _normalize_manifest(
+            manifest: DistributionManifest) -> DistributionManifest:
+        """Rewrite OCI media types to the docker schema2 equivalents —
+        byte-identical formats for gzip layers — so descriptors that
+        propagate into built images and pushes stay self-consistent.
+        Non-gzip layers (zstd, uncompressed) are rejected up front
+        rather than failing deep in the build."""
+        from makisu_tpu.docker.image import Descriptor
+        if manifest.media_type == MEDIA_TYPE_MANIFEST:
+            unsupported = [l.media_type for l in manifest.layers
+                           if l.media_type != MEDIA_TYPE_LAYER]
+            if unsupported:
+                raise ValueError(
+                    f"unsupported layer media types: {unsupported}")
+            return manifest
+        def fix(desc: Descriptor, kind_ok: str, to: str) -> Descriptor:
+            if desc.media_type != kind_ok:
+                raise ValueError(
+                    f"unsupported layer media type {desc.media_type!r} "
+                    "(only gzip tar layers are supported)")
+            return Descriptor(to, desc.size, desc.digest)
+        return DistributionManifest(
+            schema_version=2,
+            media_type=MEDIA_TYPE_MANIFEST,
+            config=Descriptor(MEDIA_TYPE_CONFIG, manifest.config.size,
+                              manifest.config.digest),
+            layers=[fix(l, MEDIA_TYPE_OCI_LAYER, MEDIA_TYPE_LAYER)
+                    for l in manifest.layers])
 
     def pull_layer(self, digest: Digest) -> str:
         """Download one blob into the CAS store (no-op if present).

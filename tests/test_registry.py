@@ -6,7 +6,11 @@ fault injection via response overrides.
 
 import pytest
 
-from makisu_tpu.docker.image import Digest, ImageName
+from makisu_tpu.docker.image import (
+    MEDIA_TYPE_OCI_MANIFEST,
+    Digest,
+    ImageName,
+)
 from makisu_tpu.registry import (
     RegistryClient,
     RegistryConfig,
@@ -158,7 +162,7 @@ def test_pull_oci_manifest(store, fixture):
         MEDIA_TYPE_OCI_CONFIG,
         MEDIA_TYPE_OCI_LAYER,
         MEDIA_TYPE_OCI_MANIFEST,
-    )
+    )  # noqa: F811 (test-local clarity)
     manifest, config_blob, blobs = make_test_image()
     raw = json_mod.loads(manifest.to_bytes())
     raw["mediaType"] = MEDIA_TYPE_OCI_MANIFEST
@@ -168,7 +172,46 @@ def test_pull_oci_manifest(store, fixture):
     fixture.manifests["team/app:oci"] = json_mod.dumps(raw).encode()
     fixture.blobs.update(blobs)
     c = client(store, fixture)
+    orig = fixture.round_trip
+    accepts = []
+
+    def spy(method, url, headers, body=None, timeout=60.0, stream_to=None):
+        if "/manifests/" in url:
+            accepts.append(headers.get("Accept", ""))
+        return orig(method, url, headers, body, timeout)
+
+    fixture.round_trip = spy
     pulled = c.pull(ImageName("registry.test", "team/app", "oci"))
+    # The Accept header advertises both manifest types (the product
+    # change under test).
+    assert accepts and MEDIA_TYPE_OCI_MANIFEST in accepts[0]
+    assert "docker.distribution.manifest.v2" in accepts[0]
     assert len(pulled.layers) == 1
+    # OCI media types normalize to docker equivalents on the way in.
+    from makisu_tpu.docker.image import MEDIA_TYPE_LAYER
+    assert all(l.media_type == MEDIA_TYPE_LAYER for l in pulled.layers)
     for digest in [pulled.config.digest] + pulled.layer_digests():
         assert store.layers.exists(digest.hex())
+
+
+def test_pull_manifest_rejects_index(store, fixture):
+    import json as json_mod
+    index = {"schemaVersion": 2,
+             "mediaType": "application/vnd.oci.image.index.v1+json",
+             "manifests": []}
+    fixture.manifests["team/app:multi"] = json_mod.dumps(index).encode()
+    with pytest.raises(ValueError, match="multi-arch"):
+        client(store, fixture).pull_manifest("multi")
+
+
+def test_pull_manifest_rejects_zstd_layers(store, fixture):
+    import json as json_mod
+    manifest, config_blob, blobs = make_test_image()
+    raw = json_mod.loads(manifest.to_bytes())
+    raw["mediaType"] = MEDIA_TYPE_OCI_MANIFEST
+    raw["config"]["mediaType"] = "application/vnd.oci.image.config.v1+json"
+    for layer in raw["layers"]:
+        layer["mediaType"] = "application/vnd.oci.image.layer.v1.tar+zstd"
+    fixture.manifests["team/app:zstd"] = json_mod.dumps(raw).encode()
+    with pytest.raises(ValueError, match="layer media type"):
+        client(store, fixture).pull_manifest("zstd")
