@@ -17,10 +17,17 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Persist XLA compiles across rounds (first TPU compile is slow).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:

@@ -64,18 +64,54 @@ class LayerSink:
     """CPU layer sink: gzip + (tar digest, gzip digest) streaming.
 
     Subclasses tap the uncompressed tar stream for extra work.
+
+    On multicore hosts, compression runs on a worker thread behind a
+    bounded queue so the tar digest (and TPU tap) overlap with gzip —
+    the reference's ConcurrentMultiWriter fan-out
+    (lib/stream/multi_writer.go:25, lib/builder/step/common.go:47-56).
+    Both hashlib and zlib release the GIL, so the overlap is real.
     """
 
-    def __init__(self, out: BinaryIO, compression_level: int | None = None
-                 ) -> None:
+    def __init__(self, out: BinaryIO, compression_level: int | None = None,
+                 threaded: bool | None = None) -> None:
+        import os as _os
         self._tar_digest = hashlib.sha256()
         self._tee = _TeeDigest(out)
         self._gz = tario.gzip_writer(self._tee, compression_level)
         self._closed = False
+        if threaded is None:
+            threaded = (_os.cpu_count() or 1) > 1
+        self._queue = None
+        self._worker = None
+        self._worker_error: list[BaseException] = []
+        if threaded:
+            import queue
+            import threading
+            self._queue = queue.Queue(maxsize=8)
+
+            def run() -> None:
+                while True:
+                    item = self._queue.get()
+                    if item is None:
+                        return
+                    try:
+                        self._gz.write(item)
+                    except BaseException as e:  # noqa: BLE001
+                        self._worker_error.append(e)
+                        return
+
+            self._worker = threading.Thread(target=run, daemon=True)
+            self._worker.start()
 
     def write(self, data: bytes) -> int:
+        if self._worker_error:
+            raise RuntimeError("layer compression failed") \
+                from self._worker_error[0]
+        if self._queue is not None:
+            self._queue.put(bytes(data))
         self._tar_digest.update(data)
-        self._gz.write(data)
+        if self._queue is None:
+            self._gz.write(data)
         self._tap(data)
         return len(data)
 
@@ -89,6 +125,12 @@ class LayerSink:
         if self._closed:
             raise RuntimeError("layer sink already finished")
         self._closed = True
+        if self._queue is not None:
+            self._queue.put(None)
+            self._worker.join()
+            if self._worker_error:
+                raise RuntimeError("layer compression failed") \
+                    from self._worker_error[0]
         self._gz.close()
         self._tee.flush()
         pair = DigestPair(

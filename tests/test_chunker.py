@@ -190,3 +190,19 @@ def test_pgzip_backend_layer_sink_and_reconstitution(tmp_path):
         assert rebuilt == blob
     finally:
         tario.set_gzip_backend("zlib")
+
+
+def test_threaded_sink_matches_inline():
+    """The ConcurrentMultiWriter-style threaded sink must be byte- and
+    digest-identical to the inline path."""
+    from makisu_tpu.chunker.hasher import LayerSink
+    payload = rand_bytes(400_000, 13)
+    results = []
+    for threaded in (False, True):
+        out = io.BytesIO()
+        sink = LayerSink(out, threaded=threaded)
+        for i in range(0, len(payload), 30_000):
+            sink.write(payload[i:i + 30_000])
+        commit = sink.finish()
+        results.append((out.getvalue(), commit.digest_pair))
+    assert results[0] == results[1]
