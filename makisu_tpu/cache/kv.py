@@ -76,6 +76,13 @@ class FSStore:
 
     def put(self, key: str, value: str) -> None:
         with self._lock:
+            # Merge-on-write: another process (worker + CLI sharing a
+            # storage dir) may have persisted entries since our load;
+            # last-writer-wins per KEY instead of per file.
+            current = dict(self._data)
+            self._data = {}
+            self._load()
+            self._data.update(current)
             self._data[key] = (value, time.time())
             self._persist_locked()
 

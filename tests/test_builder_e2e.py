@@ -277,3 +277,17 @@ def test_cache_manager_thread_safety(tmp_path):
     mgr.wait_for_push()
     assert not errors
     assert mgr.pull_cache("id-0-0") is not None
+
+
+def test_fs_store_merges_across_instances(tmp_path):
+    """Two FSStore instances over one file (worker + CLI sharing a
+    storage dir) must not clobber each other's entries."""
+    from makisu_tpu.cache import FSStore
+    path = str(tmp_path / "kv.json")
+    a = FSStore(path)
+    b = FSStore(path)
+    a.put("from-a", "1")
+    b.put("from-b", "2")
+    fresh = FSStore(path)
+    assert fresh.get("from-a") == "1"
+    assert fresh.get("from-b") == "2"
