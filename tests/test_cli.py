@@ -146,3 +146,19 @@ def test_cli_build_push(tmp_path, fixture_registry, context):
                    "--push", "registry.test"])
     assert rc == 0
     assert "team/direct:2" in fixture.manifests
+
+
+def test_cli_build_replicas(tmp_path, fixture_registry, context):
+    fixture = fixture_registry({})
+    root = tmp_path / "root"
+    root.mkdir()
+    rc = cli.main(["build", str(context), "-t", "team/app:main",
+                   "--replica", "team/app:canary",
+                   "--storage", str(tmp_path / "s"),
+                   "--root", str(root),
+                   "--push", "registry.test"])
+    assert rc == 0
+    assert "team/app:main" in fixture.manifests
+    assert "team/app:canary" in fixture.manifests
+    assert fixture.manifests["team/app:main"] == \
+        fixture.manifests["team/app:canary"]
