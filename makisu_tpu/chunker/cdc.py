@@ -105,9 +105,14 @@ class ChunkSession:
     def __init__(self, avg_bits: int = gear.DEFAULT_AVG_BITS,
                  min_size: int = gear.DEFAULT_MIN_SIZE,
                  max_size: int = gear.DEFAULT_MAX_SIZE,
-                 block: int = BLOCK) -> None:
+                 block: int = BLOCK, service=None) -> None:
         if block % 32:
             raise ValueError("block size must be a multiple of 32")
+        # Optional chunker.service.HashService: concurrent builds in one
+        # process share full device batches instead of dispatching their
+        # own (worker mode / build farms).
+        self.service = service
+        self._service_pending: list[tuple[int, int, object]] = []
         self.avg_bits = avg_bits
         self.min_size = min_size
         self.max_size = max_size
@@ -146,6 +151,9 @@ class ChunkSession:
             self._tail.clear()
         for b in self._batchers:
             self._chunks.extend(b.drain())
+        for offset, length, fut in self._service_pending:
+            self._chunks.append(Chunk(offset, length, fut.result()))
+        self._service_pending = []
         self._chunks.sort(key=lambda c: c.offset)
         return self._chunks
 
@@ -219,6 +227,10 @@ class ChunkSession:
         self._prev_cut = end
 
     def _emit(self, data: bytes, offset: int) -> None:
+        if self.service is not None:
+            self._service_pending.append(
+                (offset, len(data), self.service.submit(data)))
+            return
         for b in self._batchers:
             if len(data) <= b.cap - 64:  # leave room for sha padding
                 b.add(offset, memoryview(data))

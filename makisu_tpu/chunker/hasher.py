@@ -172,22 +172,33 @@ class _TPUSink(LayerSink):
 
 
 class TPUHasher:
-    """CPU digests + accelerator-side CDC chunk fingerprints."""
+    """CPU digests + accelerator-side CDC chunk fingerprints.
+
+    ``shared=True`` routes chunk hashing through the process-wide
+    HashService so concurrent builds fill common device batches
+    (worker/build-farm mode).
+    """
 
     name = "tpu"
 
     def __init__(self, avg_bits: int | None = None,
                  min_size: int | None = None,
-                 max_size: int | None = None) -> None:
+                 max_size: int | None = None,
+                 shared: bool = False) -> None:
         from makisu_tpu.ops import gear
         self.avg_bits = avg_bits or gear.DEFAULT_AVG_BITS
         self.min_size = min_size or gear.DEFAULT_MIN_SIZE
         self.max_size = max_size or gear.DEFAULT_MAX_SIZE
+        self.shared = shared
 
     def open_layer(self, out: BinaryIO) -> LayerSink:
         from makisu_tpu.chunker.cdc import ChunkSession
+        service = None
+        if self.shared:
+            from makisu_tpu.chunker.service import shared_service
+            service = shared_service()
         return _TPUSink(out, ChunkSession(
-            self.avg_bits, self.min_size, self.max_size))
+            self.avg_bits, self.min_size, self.max_size, service=service))
 
 
 def get_hasher(name: str) -> Hasher:

@@ -1,0 +1,110 @@
+"""Shared hash service: many concurrent builds, one accelerator.
+
+A build farm node (worker mode, BASELINE config 5: 64 concurrent jobs
+sharing a chip/mesh) must not let each build dispatch its own half-empty
+lane batches. The service multiplexes chunk-hash requests from every
+in-process ChunkSession into full fixed-shape lane batches behind a
+single dispatcher thread: callers submit chunk bytes and get a Future;
+the dispatcher packs whatever is pending (up to the bucket's lane count,
+with a short linger for stragglers), dispatches one program, and
+resolves futures on readback.
+
+Effects: device programs stay the two compiled bucket shapes, batches
+run full under concurrency, and per-build latency is bounded by the
+linger (default 2ms) instead of other builds' progress.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+from makisu_tpu.ops import sha256
+from makisu_tpu.chunker.cdc import _BUCKETS
+
+
+class HashService:
+    """Cross-build chunk-hash batcher. Thread-safe; one per process."""
+
+    def __init__(self, linger_seconds: float = 0.002) -> None:
+        self.linger = linger_seconds
+        self._queues: list[queue.Queue] = [queue.Queue() for _ in _BUCKETS]
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._dispatch_loop, args=(i,),
+                             daemon=True, name=f"hashsvc-{cap}")
+            for i, (cap, _) in enumerate(_BUCKETS)
+        ]
+        self.batches = 0  # dispatched program count (observability)
+        for t in self._threads:
+            t.start()
+
+    def submit(self, data: bytes) -> "Future[bytes]":
+        """Hash one chunk; resolves to the 32-byte sha256 digest."""
+        fut: Future = Future()
+        for i, (cap, _) in enumerate(_BUCKETS):
+            if len(data) <= cap - 64:
+                self._queues[i].put((data, fut))
+                return fut
+        raise ValueError(f"chunk of {len(data)} bytes exceeds every bucket")
+
+    def _dispatch_loop(self, bucket: int) -> None:
+        cap, lanes = _BUCKETS[bucket]
+        q = self._queues[bucket]
+        while not self._stop.is_set():
+            try:
+                first = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = threading.Event()
+            # Linger briefly to fill the batch from concurrent builds.
+            end = self.linger
+            import time
+            t0 = time.monotonic()
+            while len(batch) < lanes:
+                remaining = end - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._run_batch(cap, lanes, batch)
+
+    def _run_batch(self, cap: int, lanes: int, batch) -> None:
+        data = np.zeros((lanes, cap), dtype=np.uint8)
+        lengths = np.zeros(lanes, dtype=np.int32)
+        for i, (chunk, _) in enumerate(batch):
+            data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+            lengths[i] = len(chunk)
+        try:
+            words = np.asarray(sha256.sha256_lanes(data, lengths))
+        except BaseException as e:  # noqa: BLE001
+            for _, fut in batch:
+                fut.set_exception(e)
+            return
+        self.batches += 1
+        for i, (_, fut) in enumerate(batch):
+            fut.set_result(words[i].astype(">u4").tobytes())
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+_global_service: HashService | None = None
+_global_lock = threading.Lock()
+
+
+def shared_service() -> HashService:
+    """Process-wide service (worker mode enables it for all builds)."""
+    global _global_service
+    with _global_lock:
+        if _global_service is None:
+            _global_service = HashService()
+        return _global_service

@@ -1,0 +1,85 @@
+"""Shared HashService: concurrent builds, one device batch stream."""
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from makisu_tpu.chunker.cdc import ChunkSession
+from makisu_tpu.chunker.service import HashService
+
+
+@pytest.fixture
+def service():
+    svc = HashService(linger_seconds=0.02)
+    yield svc
+    svc.close()
+
+
+def test_service_digests_correct(service):
+    payloads = [np.random.default_rng(i).integers(
+        0, 256, size=5000 + i * 137, dtype=np.uint8).tobytes()
+        for i in range(40)]
+    futures = [service.submit(p) for p in payloads]
+    for p, fut in zip(payloads, futures):
+        assert fut.result(timeout=60) == hashlib.sha256(p).digest()
+
+
+def test_service_batches_across_submitters(service):
+    payloads = [np.random.default_rng(100 + i).integers(
+        0, 256, size=4000, dtype=np.uint8).tobytes() for i in range(64)]
+    futures = []
+    lock = threading.Lock()
+
+    def submitter(chunk):
+        fut = service.submit(chunk)
+        with lock:
+            futures.append((chunk, fut))
+
+    threads = [threading.Thread(target=submitter, args=(p,))
+               for p in payloads]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for chunk, fut in futures:
+        assert fut.result(timeout=60) == hashlib.sha256(chunk).digest()
+    # Batching happened: far fewer device programs than chunks.
+    assert service.batches < len(payloads)
+
+
+def test_sessions_with_service_match_without(service):
+    payload = np.random.default_rng(7).integers(
+        0, 256, size=300_000, dtype=np.uint8).tobytes()
+
+    def run(svc):
+        s = ChunkSession(block=64 * 1024, service=svc)
+        s.update(payload)
+        return [(c.offset, c.length, c.digest) for c in s.finish()]
+
+    assert run(None) == run(service)
+
+
+def test_concurrent_sessions_through_service(service):
+    payloads = [np.random.default_rng(200 + i).integers(
+        0, 256, size=200_000, dtype=np.uint8).tobytes() for i in range(6)]
+    results = {}
+
+    def build(i):
+        s = ChunkSession(block=64 * 1024, service=service)
+        s.update(payloads[i])
+        results[i] = s.finish()
+
+    threads = [threading.Thread(target=build, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, payload in enumerate(payloads):
+        chunks = results[i]
+        assert sum(c.length for c in chunks) == len(payload)
+        for c in chunks:
+            assert c.digest == hashlib.sha256(
+                payload[c.offset:c.offset + c.length]).digest()
