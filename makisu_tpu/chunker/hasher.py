@@ -202,8 +202,12 @@ class TPUHasher:
 
 
 def get_hasher(name: str) -> Hasher:
+    import os
     if name == "cpu":
         return CPUHasher()
     if name == "tpu":
-        return TPUHasher()
+        # Worker mode sets MAKISU_TPU_SHARED_HASH so concurrent builds
+        # batch onto the shared device stream.
+        return TPUHasher(
+            shared=os.environ.get("MAKISU_TPU_SHARED_HASH") == "1")
     raise ValueError(f"unknown hasher {name!r} (choose cpu or tpu)")

@@ -110,6 +110,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         handler = _EmitHandler()
         logger = get_logger()
         logger.addHandler(handler)
+        os.environ["MAKISU_TPU_SHARED_HASH"] = "1"  # batch across builds
         try:
             return cli.main(argv)
         except SystemExit as e:
