@@ -57,11 +57,14 @@ class WorkerClient:
             return False
 
     def exit(self) -> None:
-        conn, resp = self._request("GET", "/exit")
         try:
-            resp.read()
-        finally:
-            conn.close()
+            conn, resp = self._request("GET", "/exit")
+            try:
+                resp.read()
+            finally:
+                conn.close()
+        except OSError:
+            pass  # the worker may shut down before the response lands
 
     def prepare_context(self, context_dir: str) -> str:
         """Copy the build context into the shared mount and return the
