@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 
 import numpy as np
@@ -29,9 +30,16 @@ from makisu_tpu.chunker.cdc import _BUCKETS
 class HashService:
     """Cross-build chunk-hash batcher. Thread-safe; one per process."""
 
+    # Backpressure: per-bucket queue depth caps pending chunk BYTES at
+    # ~2 full batches; faster producers block in submit() instead of
+    # accumulating host memory without bound.
+    QUEUE_DEPTH_BATCHES = 2
+
     def __init__(self, linger_seconds: float = 0.002) -> None:
         self.linger = linger_seconds
-        self._queues: list[queue.Queue] = [queue.Queue() for _ in _BUCKETS]
+        self._queues: list[queue.Queue] = [
+            queue.Queue(maxsize=lanes * self.QUEUE_DEPTH_BATCHES)
+            for _, lanes in _BUCKETS]
         self._stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._dispatch_loop, args=(i,),
@@ -60,10 +68,8 @@ class HashService:
             except queue.Empty:
                 continue
             batch = [first]
-            deadline = threading.Event()
             # Linger briefly to fill the batch from concurrent builds.
             end = self.linger
-            import time
             t0 = time.monotonic()
             while len(batch) < lanes:
                 remaining = end - (time.monotonic() - t0)
@@ -92,9 +98,18 @@ class HashService:
             fut.set_result(words[i].astype(">u4").tobytes())
 
     def close(self) -> None:
+        """Stop dispatchers; fail any still-queued futures so no caller
+        blocks forever in fut.result()."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+        for q in self._queues:
+            while True:
+                try:
+                    _, fut = q.get_nowait()
+                except queue.Empty:
+                    break
+                fut.set_exception(RuntimeError("hash service closed"))
 
 
 _global_service: HashService | None = None

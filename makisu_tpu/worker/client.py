@@ -63,7 +63,7 @@ class WorkerClient:
                 resp.read()
             finally:
                 conn.close()
-        except OSError:
+        except (OSError, http.client.HTTPException):
             pass  # the worker may shut down before the response lands
 
     def prepare_context(self, context_dir: str) -> str:
