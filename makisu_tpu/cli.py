@@ -141,13 +141,7 @@ def _parse_build_args(pairs: list[str]) -> dict[str, str]:
 
 
 def _new_cache_manager(args, store):
-    from makisu_tpu.cache import (
-        CacheManager,
-        FSStore,
-        HTTPStore,
-        MemoryStore,
-        RedisStore,
-    )
+    from makisu_tpu.cache import CacheManager, FSStore, HTTPStore, RedisStore
     from makisu_tpu.dockerfile import parse_duration
     ttl = parse_duration(args.local_cache_ttl) / 1e9
     if args.redis_cache_addr:

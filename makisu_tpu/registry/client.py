@@ -22,7 +22,6 @@ from makisu_tpu.docker.image import (
     MEDIA_TYPE_CONFIG,
     MEDIA_TYPE_LAYER,
     MEDIA_TYPE_MANIFEST,
-    MEDIA_TYPE_OCI_CONFIG,
     MEDIA_TYPE_OCI_LAYER,
     MEDIA_TYPE_OCI_MANIFEST,
     Digest,

@@ -9,7 +9,6 @@ import os
 
 from makisu_tpu.utils import fileio
 from makisu_tpu.utils import logging as log
-from makisu_tpu.utils import pathutils
 
 
 class RootPreserver:
