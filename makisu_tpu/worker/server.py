@@ -79,6 +79,10 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
         self.socket_path = socket_path
+        # Builds run one at a time: steps export ARG/ENV into the process
+        # environment (reference semantics), which cannot interleave.
+        # /ready and /exit stay concurrent on their own threads.
+        self._build_lock = threading.Lock()
 
     # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
     # wants a (host, port) tuple for logging.
@@ -111,6 +115,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         logger = get_logger()
         logger.addHandler(handler)
         os.environ["MAKISU_TPU_SHARED_HASH"] = "1"  # batch across builds
+        self._build_lock.acquire()
         try:
             return cli.main(argv)
         except SystemExit as e:
@@ -119,6 +124,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             emit(json.dumps({"level": "error", "msg": str(e)}))
             return 1
         finally:
+            self._build_lock.release()
             logger.removeHandler(handler)
 
     def serve_background(self) -> threading.Thread:

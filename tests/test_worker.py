@@ -94,3 +94,34 @@ def test_worker_cli_subcommand(tmp_path):
         proc.wait(timeout=10)
     finally:
         proc.kill()
+
+
+def test_concurrent_build_requests_serialize(tmp_path, worker):
+    """Two simultaneous /build requests both succeed (builds serialize
+    inside the worker; process-env step exports must not interleave)."""
+    import threading
+
+    results = {}
+
+    def one(i):
+        ctx = tmp_path / f"ctx{i}"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text(
+            f"FROM scratch\nCOPY f.txt /f{i}.txt\nENV N={i}\n")
+        (ctx / "f.txt").write_text(str(i))
+        (tmp_path / f"root{i}").mkdir()
+        client = WorkerClient(worker.socket_path)
+        results[i] = client.build([
+            "build", str(ctx), "-t", f"w/c{i}:1",
+            "--storage", str(tmp_path / f"s{i}"),
+            "--root", str(tmp_path / f"root{i}"),
+            "--dest", str(tmp_path / f"out{i}.tar")])
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {0: 0, 1: 0}
+    for i in range(2):
+        assert (tmp_path / f"out{i}.tar").exists()
