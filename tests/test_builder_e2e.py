@@ -291,3 +291,30 @@ def test_fs_store_merges_across_instances(tmp_path):
     fresh = FSStore(path)
     assert fresh.get("from-a") == "1"
     assert fresh.get("from-b") == "2"
+
+
+def test_builds_are_reproducible(tmp_path):
+    """Two independent builds of the same context produce byte-identical
+    layer blobs (mtime-preserving copies + deterministic gzip) — a
+    property docker builds lack. RUN layers are exempt (execution
+    timestamps); this covers COPY/metadata builds."""
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "app.py").write_text("print('x')\n")
+    (ctx_dir / "lib").mkdir()
+    (ctx_dir / "lib" / "util.py").write_text("pass\n")
+    df = ("FROM scratch\nCOPY . /app/\nENV A=1\n"
+          'ENTRYPOINT ["python", "/app/app.py"]\n')
+
+    def build_once(name):
+        root = tmp_path / f"root-{name}"
+        root.mkdir()
+        store = ImageStore(str(tmp_path / f"store-{name}"))
+        ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+        plan = BuildPlan(ctx, ImageName("", "repro/app", name), [],
+                         NoopCacheManager(), parse_file(df),
+                         allow_modify_fs=False, force_commit=False)
+        manifest = plan.execute()
+        return [str(l.digest) for l in manifest.layers]
+
+    assert build_once("one") == build_once("two")

@@ -84,3 +84,29 @@ def test_chunk_policy_covers_any_stream(data):
     for c in cuts[:-1]:
         assert 0 < c - prev <= 256
         prev = c
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=40_000),
+                min_size=1, max_size=12))
+def test_chunking_invariant_under_write_splits(split_sizes):
+    """Chunk identity must not depend on how callers slice their
+    writes."""
+    import numpy as np
+
+    from makisu_tpu.chunker.cdc import ChunkSession
+    total = sum(split_sizes)
+    payload = np.random.default_rng(total).integers(
+        0, 256, size=total, dtype=np.uint8).tobytes()
+
+    ref = ChunkSession(block=32 * 1024)
+    ref.update(payload)
+    want = [(c.offset, c.length, c.digest) for c in ref.finish()]
+
+    s = ChunkSession(block=32 * 1024)
+    pos = 0
+    for n in split_sizes:
+        s.update(payload[pos:pos + n])
+        pos += n
+    got = [(c.offset, c.length, c.digest) for c in s.finish()]
+    assert got == want
