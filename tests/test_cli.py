@@ -162,3 +162,18 @@ def test_cli_build_replicas(tmp_path, fixture_registry, context):
     assert "team/app:canary" in fixture.manifests
     assert fixture.manifests["team/app:main"] == \
         fixture.manifests["team/app:canary"]
+
+
+@pytest.mark.parametrize("level", ["no", "speed", "size"])
+def test_build_compression_levels(tmp_path, context, level):
+    import makisu_tpu.tario as tario
+    root = tmp_path / f"root-{level}"
+    root.mkdir()
+    dest = tmp_path / f"img-{level}.tar"
+    rc = cli.main(["build", str(context), "-t", f"c/{level}:1",
+                   "--storage", str(tmp_path / f"s-{level}"),
+                   "--root", str(root), "--compression", level,
+                   "--dest", str(dest)])
+    assert rc == 0
+    assert dest.exists()
+    tario.set_compression("default")  # restore global for other tests
