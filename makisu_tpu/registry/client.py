@@ -35,6 +35,16 @@ from makisu_tpu.utils import logging as log
 from makisu_tpu.utils.httputil import HTTPError, Response, Transport, send
 
 
+def _sha256_file(path: str) -> str:
+    """Streaming sha256 of a file (bounded memory for multi-GB blobs)."""
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
 class _RateLimiter:
     """Token bucket over bytes (reference: PushRate :86-88)."""
 
@@ -257,7 +267,11 @@ class RegistryClient:
 
         The body streams to a sandbox file in 1MiB chunks — layer blobs
         can be multi-GB (reference pullLayerHelper:301-362 also streams
-        to a download file before committing to the CAS)."""
+        to a download file before committing to the CAS). The downloaded
+        bytes are verified against the requested digest before the CAS
+        link (reference client.go:288-289, saveLayer verify :620-627) —
+        a corrupt/truncated/tampered response must never be stored under
+        a trusted digest name."""
         import tempfile
         hex_digest = Digest(digest).hex()
         if self.store.layers.exists(hex_digest):
@@ -268,13 +282,26 @@ class RegistryClient:
             resp = self._send("GET", f"{self._base()}/blobs/{digest}",
                               accepted=(200, 307), stream_to=tmp)
             if resp.status == 307:
-                send(self.transport, "GET", resp.header("location"), {},
-                     retries=self.config.retries,
-                     timeout=self.config.timeout, stream_to=tmp)
-            if resp.body:
+                # Follow the redirect; the target streams the real blob
+                # into tmp. Never consult the 307 response's own body:
+                # it is an HTML stub (Go's http.Redirect writes one for
+                # GET) and must not clobber the blob.
+                followed = send(
+                    self.transport, "GET", resp.header("location"), {},
+                    retries=self.config.retries,
+                    timeout=self.config.timeout, stream_to=tmp)
+                if followed.status == 200 and followed.body:
+                    with open(tmp, "wb") as f:
+                        f.write(followed.body)
+            elif resp.status == 200 and resp.body:
                 # Transport without streaming support (fixtures).
                 with open(tmp, "wb") as f:
                     f.write(resp.body)
+            actual = _sha256_file(tmp)
+            if actual != hex_digest:
+                raise ValueError(
+                    f"pulled blob digest mismatch for {digest}: "
+                    f"got sha256:{actual}")
             return self.store.layers.link_file(hex_digest, tmp)
         finally:
             os.unlink(tmp)

@@ -194,6 +194,53 @@ def test_pull_oci_manifest(store, fixture):
         assert store.layers.exists(digest.hex())
 
 
+def test_pull_corrupt_blob_fails_closed(store, fixture):
+    """A registry returning wrong bytes for a digest must not poison the
+    CAS (reference client.go:288-289, 620-627)."""
+    manifest, config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "bad", manifest, blobs)
+    layer_hex = manifest.layers[0].digest.hex()
+    fixture.blobs[layer_hex] = b"corrupted bytes from a hostile registry"
+    c = client(store, fixture)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        c.pull(ImageName("registry.test", "team/app", "bad"))
+    assert not store.layers.exists(layer_hex)
+
+
+def test_pull_truncated_blob_fails_closed(store, fixture):
+    manifest, config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "trunc", manifest, blobs)
+    layer_hex = manifest.layers[0].digest.hex()
+    fixture.blobs[layer_hex] = fixture.blobs[layer_hex][:-1]
+    with pytest.raises(ValueError, match="digest mismatch"):
+        client(store, fixture).pull_layer(manifest.layers[0].digest)
+    assert not store.layers.exists(layer_hex)
+
+
+def test_pull_redirect_body_never_stored(store, fixture):
+    """A 307 blob redirect (Docker Hub, S3/GCS-backed registries) writes
+    an HTML stub in its own body; only the redirect target's bytes may
+    land in the CAS."""
+    manifest, config_blob, blobs = make_test_image()
+    layer_digest = manifest.layers[0].digest
+    layer_hex = layer_digest.hex()
+    layer_blob = blobs[layer_hex]
+    fixture.serve_image("team/app", "redir", manifest, blobs)
+    # First GET of the layer blob 307s to a CDN path, with the HTML stub
+    # Go's http.Redirect emits for GET requests.
+    fixture.override(
+        "GET", rf"/blobs/sha256:{layer_hex}",
+        Response(307, {"location": "https://cdn.test/real-blob"},
+                 b'<a href="https://cdn.test/real-blob">Temporary '
+                 b"Redirect</a>.\n\n"))
+    fixture.override("GET", r"cdn\.test/real-blob", Response(
+        200, {}, layer_blob))
+    c = client(store, fixture)
+    path = c.pull_layer(layer_digest)
+    with open(path, "rb") as f:
+        assert f.read() == layer_blob
+
+
 def test_pull_manifest_rejects_index(store, fixture):
     import json as json_mod
     index = {"schemaVersion": 2,
