@@ -98,6 +98,14 @@ class RegistryClient:
             scheme = "http"
         return f"{scheme}://{host}/v2/{self.repository}"
 
+    def _absolute(self, location: str) -> str:
+        """Resolve a possibly-relative Location header against the
+        registry origin (the v2 spec allows both forms)."""
+        if location.startswith("http"):
+            return location
+        base = self._base().split("/v2/")[0]
+        return base + location
+
     def _basic_credentials(self) -> tuple[str, str] | None:
         sec = self.config.security
         if sec.basic_user:
@@ -276,28 +284,37 @@ class RegistryClient:
         hex_digest = Digest(digest).hex()
         if self.store.layers.exists(hex_digest):
             return self.store.layers.path(hex_digest)
+        redirects = (301, 302, 303, 307, 308)
         fd, tmp = tempfile.mkstemp(prefix="blob-")
         os.close(fd)
         try:
             resp = self._send("GET", f"{self._base()}/blobs/{digest}",
-                              accepted=(200, 307), stream_to=tmp)
-            if resp.status == 307:
-                # Follow the redirect; the target streams the real blob
-                # into tmp. Never consult the 307 response's own body:
-                # it is an HTML stub (Go's http.Redirect writes one for
-                # GET) and must not clobber the blob.
-                followed = send(
-                    self.transport, "GET", resp.header("location"), {},
+                              accepted=(200,) + redirects, stream_to=tmp)
+            if resp.status in redirects:
+                # Follow the redirect (Docker Hub / S3 / GCS-backed
+                # registries offload blob GETs this way); the target
+                # streams the real blob into tmp. Never consult the
+                # redirect response's own body: it is an HTML stub
+                # (Go's http.Redirect writes one for GET) and must not
+                # clobber the blob.
+                location = self._absolute(resp.header("location"))
+                resp = send(
+                    self.transport, "GET", location, {},
                     retries=self.config.retries,
                     timeout=self.config.timeout, stream_to=tmp)
-                if followed.status == 200 and followed.body:
-                    with open(tmp, "wb") as f:
-                        f.write(followed.body)
-            elif resp.status == 200 and resp.body:
+            if resp.status == 200 and resp.body:
                 # Transport without streaming support (fixtures).
                 with open(tmp, "wb") as f:
                     f.write(resp.body)
-            actual = _sha256_file(tmp)
+            # Prefer the hash computed while the bytes streamed in; only
+            # non-streaming transports cost a re-read of tmp.
+            if resp.stream_sha256:
+                actual = resp.stream_sha256
+            elif resp.body:
+                import hashlib
+                actual = hashlib.sha256(resp.body).hexdigest()
+            else:
+                actual = _sha256_file(tmp)
             if actual != hex_digest:
                 raise ValueError(
                     f"pulled blob digest mismatch for {digest}: "
@@ -362,10 +379,7 @@ class RegistryClient:
     def _push_layer_content(self, digest: Digest) -> None:
         resp = self._send("POST", f"{self._base()}/blobs/uploads/",
                           accepted=(202,))
-        location = resp.header("location")
-        if not location.startswith("http"):
-            base = self._base().split("/v2/")[0]
-            location = base + location
+        location = self._absolute(resp.header("location"))
         chunk = self.config.push_chunk
         path = self.store.layers.path(digest.hex())
         size = os.path.getsize(path)
@@ -384,10 +398,8 @@ class RegistryClient:
                     },
                     body=piece, accepted=(202,))
                 off += len(piece)
-                location = resp.header("location") or location
-                if not location.startswith("http"):
-                    base = self._base().split("/v2/")[0]
-                    location = base + location
+                location = self._absolute(
+                    resp.header("location") or location)
         sep = "&" if "?" in location else "?"
         self._send("PUT", f"{location}{sep}digest={digest}",
                    accepted=(201, 204))

@@ -39,6 +39,10 @@ class Response:
     status: int
     headers: dict[str, str]
     body: bytes
+    # sha256 of the bytes written to ``stream_to`` (set only when the
+    # body streamed to a file) — lets callers verify content digests
+    # without a second full read of a multi-GB blob.
+    stream_sha256: str = ""
 
     def header(self, name: str) -> str:
         return self.headers.get(name.lower(), "")
@@ -84,13 +88,17 @@ class Transport:
                 resp_headers = {k.lower(): v
                                 for k, v in resp.headers.items()}
                 if stream_to is not None and resp.status // 100 == 2:
+                    import hashlib
+                    digest = hashlib.sha256()
                     with open(stream_to, "wb") as out:
                         while True:
                             chunk = resp.read(1 << 20)
                             if not chunk:
                                 break
+                            digest.update(chunk)
                             out.write(chunk)
-                    return Response(resp.status, resp_headers, b"")
+                    return Response(resp.status, resp_headers, b"",
+                                    stream_sha256=digest.hexdigest())
                 return Response(resp.status, resp_headers, resp.read())
         except urllib.error.HTTPError as e:
             data = e.read() if hasattr(e, "read") else b""

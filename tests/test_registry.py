@@ -241,6 +241,23 @@ def test_pull_redirect_body_never_stored(store, fixture):
         assert f.read() == layer_blob
 
 
+def test_pull_302_relative_redirect(store, fixture):
+    """302 with a relative Location (both allowed by the v2 spec) must
+    resolve against the registry origin and still verify."""
+    manifest, config_blob, blobs = make_test_image()
+    layer_digest = manifest.layers[0].digest
+    layer_hex = layer_digest.hex()
+    fixture.serve_image("team/app", "r302", manifest, blobs)
+    fixture.override(
+        "GET", rf"/blobs/sha256:{layer_hex}",
+        Response(302, {"location": "/cdn/real-blob"}, b"<a>Found</a>"))
+    fixture.override("GET", r"registry\.test/cdn/real-blob",
+                     Response(200, {}, blobs[layer_hex]))
+    path = client(store, fixture).pull_layer(layer_digest)
+    with open(path, "rb") as f:
+        assert f.read() == blobs[layer_hex]
+
+
 def test_pull_manifest_rejects_index(store, fixture):
     import json as json_mod
     index = {"schemaVersion": 2,
