@@ -8,7 +8,6 @@ restore/--target early exit, WaitForPush join, manifest + replicas).
 
 from __future__ import annotations
 
-import os
 import zlib
 
 import makisu_tpu
@@ -88,7 +87,6 @@ class BuildPlan:
                 f"target stage not found in dockerfile: {self.stage_target}")
 
     def execute(self) -> DistributionManifest:
-        original_env = dict(os.environ)
         curr = None
         for k, stage in enumerate(self.stages):
             curr = stage
@@ -101,10 +99,10 @@ class BuildPlan:
             if self.allow_modify_fs:
                 stage.checkpoint(self.copy_from_dirs.get(stage.alias, []))
                 stage.cleanup()
-            # RUN steps export ARG/ENV into the process env; restore
-            # between stages (reference :197-204).
-            os.environ.clear()
-            os.environ.update(original_env)
+            # ARG/ENV exports live in each stage context's exec_env
+            # (reset per stage), so no process-env restore is needed
+            # (reference restores os.environ, :197-204 — we never touch
+            # it: concurrent builds share this process).
             if self.stage_target and stage.alias == self.stage_target:
                 log.info("finished building target stage")
                 break

@@ -42,7 +42,10 @@ def encode_entry(pair: DigestPair | None,
         "tar": str(pair.tar_digest),
         "gzip": str(pair.gzip_descriptor.digest),
         "size": pair.gzip_descriptor.size,
-        "gz": tario.gzip_backend_id(),
+        # The compression identity the layer was actually written with
+        # (per-build; the process default only covers legacy callers).
+        "gz": ((commit.gzip_backend_id if commit is not None else "")
+               or tario.gzip_backend_id()),
     }
     if commit is not None and commit.chunks:
         entry["chunks"] = [[c.offset, c.length, c.hex_digest]
@@ -141,7 +144,10 @@ class CacheManager:
             except Exception as e:  # noqa: BLE001
                 log.warning("async cache push %s failed: %s", cache_id, e)
 
-        t = threading.Thread(target=push, daemon=True, name=f"cachepush-{cache_id}")
+        import contextvars
+        t = threading.Thread(target=contextvars.copy_context().run,
+                             args=(push,), daemon=True,
+                             name=f"cachepush-{cache_id}")
         t.start()
         with self._lock:
             self._pushes.append(t)

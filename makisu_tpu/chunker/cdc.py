@@ -229,7 +229,8 @@ class ChunkSession:
     def _emit(self, data: bytes, offset: int) -> None:
         if self.service is not None:
             self._service_pending.append(
-                (offset, len(data), self.service.submit(data)))
+                (offset, len(data),
+                 self.service.submit(data, owner=id(self))))
             return
         for b in self._batchers:
             if len(data) <= b.cap - 64:  # leave room for sha padding

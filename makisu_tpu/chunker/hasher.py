@@ -37,6 +37,9 @@ class LayerCommit:
 
     digest_pair: DigestPair
     chunks: list[ChunkFingerprint]
+    # Compression identity the blob was written with (cache entries
+    # record it so chunk reconstitution replays byte-identically).
+    gzip_backend_id: str = ""
 
     @property
     def chunk_ids(self) -> list[str]:
@@ -72,12 +75,13 @@ class LayerSink:
     Both hashlib and zlib release the GIL, so the overlap is real.
     """
 
-    def __init__(self, out: BinaryIO, compression_level: int | None = None,
+    def __init__(self, out: BinaryIO, backend_id: str | None = None,
                  threaded: bool | None = None) -> None:
         import os as _os
         self._tar_digest = hashlib.sha256()
         self._tee = _TeeDigest(out)
-        self._gz = tario.gzip_writer(self._tee, compression_level)
+        self.backend_id = backend_id or tario.gzip_backend_id()
+        self._gz = tario.gzip_writer(self._tee, backend_id=self.backend_id)
         self._closed = False
         if threaded is None:
             threaded = (_os.cpu_count() or 1) > 1
@@ -108,7 +112,19 @@ class LayerSink:
             raise RuntimeError("layer compression failed") \
                 from self._worker_error[0]
         if self._queue is not None:
-            self._queue.put(bytes(data))
+            # Bounded put that re-checks for a dead worker: if the
+            # compressor thread died while the queue was full, a plain
+            # put() would block forever and hang the build instead of
+            # surfacing the error.
+            import queue as queue_mod
+            while True:
+                try:
+                    self._queue.put(bytes(data), timeout=1.0)
+                    break
+                except queue_mod.Full:
+                    if self._worker_error:
+                        raise RuntimeError("layer compression failed") \
+                            from self._worker_error[0]
         self._tar_digest.update(data)
         if self._queue is None:
             self._gz.write(data)
@@ -126,7 +142,17 @@ class LayerSink:
             raise RuntimeError("layer sink already finished")
         self._closed = True
         if self._queue is not None:
-            self._queue.put(None)
+            # Same bounded put as write(): a worker that died with the
+            # queue full must surface its error, not hang the build.
+            import queue as queue_mod
+            while True:
+                try:
+                    self._queue.put(None, timeout=1.0)
+                    break
+                except queue_mod.Full:
+                    if self._worker_error:
+                        raise RuntimeError("layer compression failed") \
+                            from self._worker_error[0]
             self._worker.join()
             if self._worker_error:
                 raise RuntimeError("layer compression failed") \
@@ -138,7 +164,8 @@ class LayerSink:
             gzip_descriptor=Descriptor(
                 MEDIA_TYPE_LAYER, self._tee.size,
                 Digest.from_hex(self._tee.digest.hexdigest())))
-        return LayerCommit(pair, self._finish_chunks())
+        return LayerCommit(pair, self._finish_chunks(),
+                           gzip_backend_id=self.backend_id)
 
 
 class Hasher(Protocol):
@@ -146,7 +173,8 @@ class Hasher(Protocol):
 
     name: str
 
-    def open_layer(self, out: BinaryIO) -> LayerSink: ...
+    def open_layer(self, out: BinaryIO,
+                   backend_id: str | None = None) -> LayerSink: ...
 
 
 class CPUHasher:
@@ -154,13 +182,15 @@ class CPUHasher:
 
     name = "cpu"
 
-    def open_layer(self, out: BinaryIO) -> LayerSink:
-        return LayerSink(out)
+    def open_layer(self, out: BinaryIO,
+                   backend_id: str | None = None) -> LayerSink:
+        return LayerSink(out, backend_id=backend_id)
 
 
 class _TPUSink(LayerSink):
-    def __init__(self, out: BinaryIO, session) -> None:
-        super().__init__(out)
+    def __init__(self, out: BinaryIO, session,
+                 backend_id: str | None = None) -> None:
+        super().__init__(out, backend_id=backend_id)
         self._session = session
 
     def _tap(self, data: bytes) -> None:
@@ -191,14 +221,16 @@ class TPUHasher:
         self.max_size = max_size or gear.DEFAULT_MAX_SIZE
         self.shared = shared
 
-    def open_layer(self, out: BinaryIO) -> LayerSink:
+    def open_layer(self, out: BinaryIO,
+                   backend_id: str | None = None) -> LayerSink:
         from makisu_tpu.chunker.cdc import ChunkSession
         service = None
         if self.shared:
             from makisu_tpu.chunker.service import shared_service
             service = shared_service()
         return _TPUSink(out, ChunkSession(
-            self.avg_bits, self.min_size, self.max_size, service=service))
+            self.avg_bits, self.min_size, self.max_size, service=service),
+            backend_id=backend_id)
 
 
 def get_hasher(name: str) -> Hasher:

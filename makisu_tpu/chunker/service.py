@@ -47,15 +47,19 @@ class HashService:
             for i, (cap, _) in enumerate(_BUCKETS)
         ]
         self.batches = 0  # dispatched program count (observability)
+        # Batches that mixed chunks from >1 submitting session — direct
+        # evidence that concurrent builds share device programs.
+        self.cross_build_batches = 0
         for t in self._threads:
             t.start()
 
-    def submit(self, data: bytes) -> "Future[bytes]":
-        """Hash one chunk; resolves to the 32-byte sha256 digest."""
+    def submit(self, data: bytes, owner=None) -> "Future[bytes]":
+        """Hash one chunk; resolves to the 32-byte sha256 digest.
+        ``owner`` identifies the submitting session (observability)."""
         fut: Future = Future()
         for i, (cap, _) in enumerate(_BUCKETS):
             if len(data) <= cap - 64:
-                self._queues[i].put((data, fut))
+                self._queues[i].put((data, fut, owner))
                 return fut
         raise ValueError(f"chunk of {len(data)} bytes exceeds every bucket")
 
@@ -84,17 +88,20 @@ class HashService:
     def _run_batch(self, cap: int, lanes: int, batch) -> None:
         data = np.zeros((lanes, cap), dtype=np.uint8)
         lengths = np.zeros(lanes, dtype=np.int32)
-        for i, (chunk, _) in enumerate(batch):
+        for i, (chunk, _, _) in enumerate(batch):
             data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
             lengths[i] = len(chunk)
         try:
             words = np.asarray(sha256.sha256_lanes(data, lengths))
         except BaseException as e:  # noqa: BLE001
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 fut.set_exception(e)
             return
         self.batches += 1
-        for i, (_, fut) in enumerate(batch):
+        owners = {owner for _, _, owner in batch if owner is not None}
+        if len(owners) > 1:
+            self.cross_build_batches += 1
+        for i, (_, fut, _) in enumerate(batch):
             fut.set_result(words[i].astype(">u4").tobytes())
 
     def close(self) -> None:
@@ -106,7 +113,7 @@ class HashService:
         for q in self._queues:
             while True:
                 try:
-                    _, fut = q.get_nowait()
+                    _, fut, _ = q.get_nowait()
                 except queue.Empty:
                     break
                 fut.set_exception(RuntimeError("hash service closed"))

@@ -165,16 +165,22 @@ def cmd_build(args) -> int:
     from makisu_tpu.context import BuildContext
     from makisu_tpu.docker.image import ImageName
     from makisu_tpu.dockerfile import parse_file
-    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.registry import load_config_map, new_client
     from makisu_tpu.storage import ImageStore
 
-    if args.registry_config:
-        update_global_config(args.registry_config)
-    tario.set_compression(args.compression)
-    tario.set_gzip_backend(args.gzip_backend)
+    # Per-build registry config (never the process-global map: builds in
+    # one worker may carry different --registry-config flags).
+    registry_config_map = (load_config_map(args.registry_config)
+                          if args.registry_config else None)
+    # Validated per-build compression identity: threaded through the
+    # BuildContext rather than tario's process globals, so concurrent
+    # builds in one worker can use different flags.
+    gzip_backend_id = tario.make_backend_id(args.gzip_backend,
+                                            args.compression)
+    blacklist = list(pathutils.DEFAULT_BLACKLIST)
     for extra in args.blacklist:
-        if extra not in pathutils.DEFAULT_BLACKLIST:
-            pathutils.DEFAULT_BLACKLIST.append(extra)
+        if extra not in blacklist:
+            blacklist.append(extra)
 
     dockerfile_path = args.file or os.path.join(args.context, "Dockerfile")
     with open(dockerfile_path) as f:
@@ -185,7 +191,9 @@ def cmd_build(args) -> int:
 
     with ImageStore(_storage_dir(args.storage)) as store:
         ctx = BuildContext(args.root, os.path.abspath(args.context), store,
-                           hasher=get_hasher(args.hasher))
+                           hasher=get_hasher(args.hasher),
+                           blacklist=blacklist,
+                           gzip_backend_id=gzip_backend_id)
         cache_mgr = _new_cache_manager(args, store) or NoopCacheManager()
         if args.hasher == "tpu" and not isinstance(cache_mgr,
                                                    NoopCacheManager):
@@ -201,7 +209,8 @@ def cmd_build(args) -> int:
                              allow_modify_fs=args.modifyfs,
                              force_commit=(args.commit == "implicit"),
                              stage_target=args.target,
-                             registry_client=_FromPuller(store))
+                             registry_client=_FromPuller(
+                                 store, registry_config_map))
             manifest = plan.execute()
         finally:
             if preserver is not None:
@@ -210,10 +219,12 @@ def cmd_build(args) -> int:
 
         for registry in args.push:
             name = target.with_registry(registry)
-            client = new_client(store, name)
+            client = new_client(store, name,
+                                config_map=registry_config_map)
             client.push(name if name.registry else target)
             for replica in replicas:
-                new_client(store, replica.with_registry(registry)).push(
+                new_client(store, replica.with_registry(registry),
+                           config_map=registry_config_map).push(
                     replica.with_registry(registry))
             log.info("successfully pushed %s to %s", name, registry)
         if args.dest:
@@ -236,12 +247,14 @@ class _FromPuller:
     """Registry access for FROM steps: resolves a client per image name
     and saves manifests under the image's own name."""
 
-    def __init__(self, store) -> None:
+    def __init__(self, store, config_map=None) -> None:
         self.store = store
+        self.config_map = config_map
 
     def pull(self, name):
         from makisu_tpu.registry import new_client
-        return new_client(self.store, name).pull(name)
+        return new_client(self.store, name,
+                          config_map=self.config_map).pull(name)
 
 
 def cmd_pull(args) -> int:
@@ -313,14 +326,12 @@ def cmd_diff(args) -> int:
                 fs.update_from_tar_path(
                     store.layers.path(desc.digest.hex()), untar=False)
             trees.append(fs)
-        # Config diff first (reference: cmd/diff.go go-cmp over configs).
+        # Whole-config deep diff (reference: cmd/diff.go:117-120 go-cmp's
+        # the entire config object, so architecture/os/rootfs differences
+        # surface, not just config.* fields).
         c1, c2 = configs
-        for key in sorted(set(c1.get("config", {})) |
-                          set(c2.get("config", {}))):
-            v1 = c1.get("config", {}).get(key)
-            v2 = c2.get("config", {}).get(key)
-            if v1 != v2:
-                print(f"config {key}: {v1!r} != {v2!r}")
+        for line in _deep_diff(c1, c2):
+            print(line)
         diff = trees[0].compare(trees[1],
                                 ignore_mtime=args.ignore_modtime)
         for p in diff.missing_in_first:
@@ -332,6 +343,25 @@ def cmd_diff(args) -> int:
                   f"[{h1.mode:o} {h1.uid}:{h1.gid} {h1.size}] vs "
                   f"[{h2.mode:o} {h2.uid}:{h2.gid} {h2.size}]")
     return 0
+
+
+def _deep_diff(a, b, path: str = "") -> list[str]:
+    """Recursive structural diff of two JSON-ish values, one line per
+    differing leaf (analog of the reference's go-cmp report)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        lines = []
+        for key in sorted(set(a) | set(b)):
+            sub = f"{path}.{key}" if path else key
+            if key not in a:
+                lines.append(f"{sub}: <absent> != {b[key]!r}")
+            elif key not in b:
+                lines.append(f"{sub}: {a[key]!r} != <absent>")
+            else:
+                lines.extend(_deep_diff(a[key], b[key], sub))
+        return lines
+    if a != b:
+        return [f"{path or '<root>'}: {a!r} != {b!r}"]
+    return []
 
 
 def cmd_worker(args) -> int:

@@ -23,18 +23,33 @@ class BuildContext:
                  image_store: ImageStore,
                  hasher: Hasher | None = None,
                  blacklist: list[str] | None = None,
-                 sync_wait: float | None = None) -> None:
+                 sync_wait: float | None = None,
+                 gzip_backend_id: str | None = None) -> None:
         self.root_dir = root_dir
         self.context_dir = context_dir
         self.image_store = image_store
         self.stage_vars: dict[str, str] = {}
         self.copy_ops = []
         self.must_scan = False
+        # Per-build process environment for RUN steps. ARG/ENV exports
+        # land here, never in os.environ — concurrent builds in one
+        # worker process must not see each other's variables. Each stage
+        # starts from the snapshot taken at build start (the reference
+        # restores os.environ between stages, build_plan.go:197-204).
+        self._base_env: dict[str, str] = dict(os.environ)
+        self.exec_env: dict[str, str] = dict(self._base_env)
+        # Per-build compression identity (tario.make_backend_id); None
+        # falls back to the process default. Lives here, not in tario's
+        # globals, so concurrent builds with different flags don't race.
+        self.gzip_backend_id = gzip_backend_id
         self.hasher = hasher or CPUHasher()
         self.stages_dir = os.path.join(image_store.sandbox_dir, _STAGES_DIR)
         os.makedirs(self.stages_dir, exist_ok=True)
         if blacklist is None:
             blacklist = list(pathutils.DEFAULT_BLACKLIST)
+        # Without the build-internal dirs: copy-op sources legitimately
+        # live in the context dir, so steps extend this base themselves.
+        self.base_blacklist = list(blacklist)
         self.blacklist = blacklist + [context_dir, image_store.root]
         kwargs = {} if sync_wait is None else {"sync_wait": sync_wait}
         self.memfs = MemFS(root_dir, self.blacklist, **kwargs)
@@ -55,8 +70,12 @@ class BuildContext:
         ctx.stage_vars = {}
         ctx.copy_ops = []
         ctx.must_scan = False
+        ctx._base_env = self._base_env
+        ctx.exec_env = dict(self._base_env)
+        ctx.gzip_backend_id = self.gzip_backend_id
         ctx.hasher = self.hasher
         ctx.stages_dir = self.stages_dir
+        ctx.base_blacklist = self.base_blacklist
         ctx.blacklist = self.blacklist
         ctx.memfs = MemFS(self.root_dir, self.blacklist,
                           sync_wait=self.memfs.sync_wait)

@@ -6,6 +6,7 @@ from makisu_tpu.registry.config import (
     SecurityConfig,
     config_for,
     reset_global_config,
+    load_config_map,
     update_global_config,
 )
 from makisu_tpu.registry.fixtures import RegistryFixture, make_test_image

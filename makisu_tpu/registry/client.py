@@ -74,11 +74,12 @@ class RegistryClient:
 
     def __init__(self, store: ImageStore, registry: str, repository: str,
                  config: RegistryConfig | None = None,
-                 transport: Transport | None = None) -> None:
+                 transport: Transport | None = None,
+                 config_map=None) -> None:
         self.store = store
         self.registry = registry
         self.repository = repository
-        self.config = config or config_for(registry, repository)
+        self.config = config or config_for(registry, repository, config_map)
         self.transport = transport or Transport(
             tls_verify=self.config.security.tls_verify,
             ca_cert=self.config.security.ca_cert or None)
@@ -105,6 +106,10 @@ class RegistryClient:
             return location
         base = self._base().split("/v2/")[0]
         return base + location
+
+    def _same_origin(self, url: str) -> bool:
+        from urllib.parse import urlsplit
+        return urlsplit(url).netloc == urlsplit(self._base()).netloc
 
     def _basic_credentials(self) -> tuple[str, str] | None:
         sec = self.config.security
@@ -298,10 +303,16 @@ class RegistryClient:
                 # (Go's http.Redirect writes one for GET) and must not
                 # clobber the blob.
                 location = self._absolute(resp.header("location"))
-                resp = send(
-                    self.transport, "GET", location, {},
-                    retries=self.config.retries,
-                    timeout=self.config.timeout, stream_to=tmp)
+                if self._same_origin(location):
+                    # Same registry: keep auth (and the 401 token dance).
+                    resp = self._send("GET", location, stream_to=tmp)
+                else:
+                    # Cross-origin presigned URL (S3/GCS): forwarding
+                    # registry credentials would leak them.
+                    resp = send(
+                        self.transport, "GET", location, {},
+                        retries=self.config.retries,
+                        timeout=self.config.timeout, stream_to=tmp)
             if resp.status == 200 and resp.body:
                 # Transport without streaming support (fixtures).
                 with open(tmp, "wb") as f:
@@ -416,8 +427,10 @@ def set_transport_factory(factory) -> None:
 
 
 def new_client(store: ImageStore, name: ImageName,
-               transport: Transport | None = None) -> RegistryClient:
+               transport: Transport | None = None,
+               config_map=None) -> RegistryClient:
     if transport is None and _transport_factory is not None:
         transport = _transport_factory(name)
     return RegistryClient(store, name.registry or "index.docker.io",
-                          name.repository, transport=transport)
+                          name.repository, transport=transport,
+                          config_map=config_map)

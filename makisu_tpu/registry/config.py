@@ -83,9 +83,11 @@ _global_config: ConfigurationMap = {
 }
 
 
-def update_global_config(source: str) -> None:
-    """Load a registry config map from a YAML/JSON file path or an inline
-    JSON string, expanding ``$VARS`` from the environment."""
+def load_config_map(source: str) -> ConfigurationMap:
+    """Parse a registry config map from a YAML/JSON file path or an
+    inline JSON string, expanding ``$VARS`` from the environment —
+    without touching the process-global map (builds in one worker carry
+    their own map so concurrent --registry-config flags never race)."""
     if os.path.isfile(source):
         with open(source) as f:
             text = f.read()
@@ -97,19 +99,30 @@ def update_global_config(source: str) -> None:
     except ValueError:
         import yaml  # optional; ships with most ML images
         raw = yaml.safe_load(text)
+    out: ConfigurationMap = {}
     for registry, repos in (raw or {}).items():
-        _global_config.setdefault(registry, {})
-        for repo_regex, cfg in repos.items():
-            _global_config[registry][repo_regex] = RegistryConfig.from_json(
-                cfg or {})
+        out[registry] = {
+            repo_regex: RegistryConfig.from_json(cfg or {})
+            for repo_regex, cfg in repos.items()
+        }
+    return out
 
 
-def config_for(registry: str, repository: str) -> RegistryConfig:
-    repos = _global_config.get(registry)
-    if repos:
-        for pattern, cfg in repos.items():
-            if re.fullmatch(pattern, repository):
-                return cfg
+def update_global_config(source: str) -> None:
+    """Merge a config map into the process-global default (single-build
+    CLI commands: pull/push/diff)."""
+    for registry, repos in load_config_map(source).items():
+        _global_config.setdefault(registry, {}).update(repos)
+
+
+def config_for(registry: str, repository: str,
+               config_map: ConfigurationMap | None = None) -> RegistryConfig:
+    for source in (config_map, _global_config):
+        repos = (source or {}).get(registry)
+        if repos:
+            for pattern, cfg in repos.items():
+                if re.fullmatch(pattern, repository):
+                    return cfg
     return RegistryConfig()
 
 

@@ -49,10 +49,14 @@ def exec_command(workdir: str, user: str, *argv: str,
         bufsize=1, **popen_kwargs)
     assert proc.stdout is not None and proc.stderr is not None
     err_tail: list[str] = []
+    # Drain threads carry the caller's context so worker-mode log sinks
+    # attribute this command's output to the right build.
+    import contextvars
     readers = [
-        threading.Thread(target=_drain, args=(proc.stdout, log.info)),
-        threading.Thread(target=_drain,
-                         args=(proc.stderr, log.error, err_tail)),
+        threading.Thread(target=contextvars.copy_context().run,
+                         args=(_drain, proc.stdout, log.info)),
+        threading.Thread(target=contextvars.copy_context().run,
+                         args=(_drain, proc.stderr, log.error, err_tail)),
     ]
     for t in readers:
         t.start()

@@ -95,7 +95,7 @@ class AddCopyStep(BuildStep):
         source_root = self._source_root(ctx)
         rel_paths = [pathutils.trim_root(s, source_root)
                      for s in self._resolve_sources(ctx)]
-        blacklist = list(pathutils.DEFAULT_BLACKLIST) + [ctx.image_store.root]
+        blacklist = list(ctx.base_blacklist) + [ctx.image_store.root]
         op = CopyOperation(
             rel_paths, source_root, self.logical_working_dir, self.dst,
             chown=self.chown, blacklist=blacklist,

@@ -102,19 +102,22 @@ class BuildStep:
         self.logical_working_dir = "/"
         self.working_dir = ctx.root_dir
         if config is not None and config.config.working_dir:
-            self.logical_working_dir = os.path.expandvars(
-                config.config.working_dir)
+            from makisu_tpu.utils import envutils
+            self.logical_working_dir = envutils.expand(
+                config.config.working_dir, ctx.exec_env)
             self.working_dir = pathutils.join_root(ctx.root_dir,
                                                    self.logical_working_dir)
         if not os.path.lexists(self.working_dir):
             os.makedirs(self.working_dir, exist_ok=True)
 
     def _export_stage_vars(self, ctx: BuildContext) -> None:
-        """ARG/ENV values become process env for RUN steps."""
+        """ARG/ENV values become the RUN-step env — the build-local
+        exec_env, never os.environ (concurrent builds share a process)."""
+        from makisu_tpu.utils import envutils
         for key, value in ctx.stage_vars.items():
             if len(value) >= 2 and value[0] == value[-1] == '"':
                 value = value[1:-1]
-            os.environ[key] = os.path.expandvars(value)
+            ctx.exec_env[key] = envutils.expand(value, ctx.exec_env)
 
 
 def commit_layer(ctx: BuildContext, step: BuildStep) -> list[DigestPair]:
@@ -138,7 +141,8 @@ def commit_layer(ctx: BuildContext, step: BuildStep) -> list[DigestPair]:
                                prefix="layertar-")
     try:
         with os.fdopen(fd, "wb") as out:
-            sink = ctx.hasher.open_layer(out)
+            sink = ctx.hasher.open_layer(out,
+                                         backend_id=ctx.gzip_backend_id)
             with tarfile.open(fileobj=sink, mode="w|") as tw:
                 write_diffs(tw)
             layer_commit = sink.finish()

@@ -77,8 +77,10 @@ class EnvStep(BuildStep):
         self.envs = envs
 
     def update_config(self, ctx, config):
+        from makisu_tpu.utils import envutils
         ctx.stage_vars.update(self.envs)
-        expanded = {k: os.path.expandvars(v) for k, v in self.envs.items()}
+        expanded = {k: envutils.expand(v, ctx.exec_env)
+                    for k, v in self.envs.items()}
         config.config.env = merge_env(config.config.env, expanded)
         return config
 
@@ -191,7 +193,8 @@ class WorkdirStep(BuildStep):
         self.workdir = working_dir
 
     def update_config(self, ctx, config):
-        workdir = os.path.expandvars(self.workdir)
+        from makisu_tpu.utils import envutils
+        workdir = envutils.expand(self.workdir, ctx.exec_env)
         if os.path.isabs(workdir):
             config.config.working_dir = workdir
         else:

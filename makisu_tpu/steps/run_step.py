@@ -33,4 +33,5 @@ class RunStep(BuildStep):
             raise RuntimeError(
                 "RUN step requires a modifiable filesystem (--modifyfs)")
         ctx.must_scan = True
-        shell.exec_command(self.working_dir, self.user, "sh", "-c", self.cmd)
+        shell.exec_command(self.working_dir, self.user, "sh", "-c", self.cmd,
+                           env=ctx.exec_env)

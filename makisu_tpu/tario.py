@@ -32,8 +32,7 @@ _gzip_backend = "zlib"
 _PGZIP_BLOCK = 128 * 1024
 
 
-def set_gzip_backend(name: str) -> None:
-    global _gzip_backend
+def _validate_backend(name: str) -> None:
     if name not in ("zlib", "pgzip"):
         raise ValueError(f"unknown gzip backend {name!r}")
     if name == "pgzip":
@@ -42,14 +41,36 @@ def set_gzip_backend(name: str) -> None:
             raise ValueError(
                 "pgzip backend requested but native/libpgzip.so is not "
                 "available (run `make -C native`)")
+
+
+def set_gzip_backend(name: str) -> None:
+    global _gzip_backend
+    _validate_backend(name)
     _gzip_backend = name
 
 
-def gzip_backend_id(level: int | None = None) -> str:
+def gzip_backend_id(level: int | None = None,
+                    backend: str | None = None) -> str:
+    """The single format site for backend-id strings (cache identity:
+    recorded in cache entries, parsed back by gzip_writer)."""
     level = _compression_level if level is None else level
-    if _gzip_backend == "pgzip":
+    backend = _gzip_backend if backend is None else backend
+    if backend == "pgzip":
         return f"pgzip-{level}-{_PGZIP_BLOCK}"
     return f"zlib-{level}"
+
+
+def make_backend_id(backend: str, level_name: str) -> str:
+    """Validate a (backend, level) flag pair into a backend id string —
+    the per-build compression identity threaded through BuildContext, so
+    concurrent builds with different flags never race on the module
+    globals (those remain only as process defaults)."""
+    _validate_backend(backend)
+    if level_name not in COMPRESSION_LEVELS:
+        raise ValueError(
+            f"invalid compression level {level_name!r}; "
+            f"one of {sorted(COMPRESSION_LEVELS)}")
+    return gzip_backend_id(COMPRESSION_LEVELS[level_name], backend)
 
 
 def set_compression(name: str) -> None:

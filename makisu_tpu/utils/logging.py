@@ -5,13 +5,32 @@ Reference: lib/log (zap singleton) + bin/makisu/cmd/common.go:46-66.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import sys
 import time
-from typing import Any
+from typing import Any, Callable
 
 _LOGGER_NAME = "makisu"
+
+# Per-build log sink (worker mode): each /build request binds its own
+# sink in its context; threads a build spawns (shell output drains,
+# async cache pushes) carry the context along, so concurrent builds'
+# log streams never cross. A plain logging.Handler on the shared logger
+# could not do this — every handler sees every build's records.
+_build_sink: "contextvars.ContextVar[Callable | None]" = \
+    contextvars.ContextVar("makisu_build_sink", default=None)
+
+
+def set_build_sink(sink: "Callable[[str, str, dict], None] | None"):
+    """Bind a per-context sink receiving (level, message, fields).
+    Returns a token for reset_build_sink."""
+    return _build_sink.set(sink)
+
+
+def reset_build_sink(token) -> None:
+    _build_sink.reset(token)
 
 
 class _JsonFormatter(logging.Formatter):
@@ -67,6 +86,12 @@ def _log(level: int, msg: str, *args: Any, **fields: Any) -> None:
     if args:
         msg = msg % args
     get_logger().log(level, msg, extra={"fields": fields} if fields else {})
+    sink = _build_sink.get()
+    if sink is not None:
+        try:
+            sink(logging.getLevelName(level).lower(), msg, fields)
+        except Exception:  # noqa: BLE001 - a dead client must not kill logging
+            pass
 
 
 def debug(msg: str, *args: Any, **fields: Any) -> None:

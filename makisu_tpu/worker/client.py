@@ -78,9 +78,11 @@ class WorkerClient:
                             self.local_shared_path, name)
 
     def build(self, argv: list[str],
-              context_dir: str | None = None) -> int:
-        """Submit a build; stream log lines to the local logger; return
-        the worker's build exit code."""
+              context_dir: str | None = None,
+              on_line=None) -> int:
+        """Submit a build; stream log lines to the local logger (and
+        ``on_line(payload)`` when given); return the worker's build exit
+        code."""
         if context_dir is not None:
             worker_ctx = self.prepare_context(context_dir)
             argv = list(argv) + [worker_ctx]
@@ -109,6 +111,8 @@ class WorkerClient:
                     if "build_code" in payload:
                         build_code = int(payload["build_code"])
                     else:
+                        if on_line is not None:
+                            on_line(payload)
                         log.info("[worker] %s", payload.get("msg", line))
         finally:
             conn.close()

@@ -51,14 +51,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
+        # A build's stdout/stderr drain threads and async cache-push
+        # threads all emit concurrently; chunk framing must be atomic or
+        # interleaved writes corrupt the HTTP stream.
+        emit_lock = threading.Lock()
+
         def emit(line: str) -> None:
             data = (line.rstrip("\n") + "\n").encode()
-            self.wfile.write(f"{len(data):x}\r\n".encode())
-            self.wfile.write(data + b"\r\n")
+            frame = f"{len(data):x}\r\n".encode() + data + b"\r\n"
+            with emit_lock:
+                self.wfile.write(frame)
 
         code = self.server.run_build(argv, emit)
         emit(json.dumps({"build_code": str(code)}))
-        self.wfile.write(b"0\r\n\r\n")
+        with emit_lock:
+            self.wfile.write(b"0\r\n\r\n")
 
     def _respond(self, status: int, body: bytes) -> None:
         try:
@@ -79,10 +86,16 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
         self.socket_path = socket_path
-        # Builds run one at a time: steps export ARG/ENV into the process
-        # environment (reference semantics), which cannot interleave.
-        # /ready and /exit stay concurrent on their own threads.
-        self._build_lock = threading.Lock()
+        # Builds from all connections share one process — and therefore
+        # one HashService, so chunk hashing from concurrent builds
+        # batches onto full device programs (the build-farm scenario).
+        # Step env lives in each BuildContext's exec_env, so builds run
+        # genuinely concurrently with no cross-talk.
+        os.environ["MAKISU_TPU_SHARED_HASH"] = "1"
+        # Builds sharing a --root or --storage directory would race on
+        # the filesystem; those (and only those) serialize.
+        self._path_locks: dict[str, threading.Lock] = {}
+        self._path_locks_mu = threading.Lock()
 
     # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
     # wants a (host, port) tuple for logging.
@@ -91,31 +104,24 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         return request, ("worker", 0)
 
     def run_build(self, argv: list[str], emit) -> int:
-        """Run one build command in-process, forwarding log lines."""
-        import logging
+        """Run one build command in-process, forwarding log lines.
 
+        The emit sink binds to this request's context (and the threads
+        the build spawns), so concurrent builds' streams stay separate —
+        client A never sees client B's log lines."""
         from makisu_tpu import cli
-        from makisu_tpu.utils.logging import get_logger
+        from makisu_tpu.utils import logging as log
 
-        class _EmitHandler(logging.Handler):
-            def __init__(self) -> None:
-                super().__init__()
-                self.setFormatter(logging.Formatter("%(message)s"))
+        def sink(level: str, msg: str, fields: dict) -> None:
+            try:
+                emit(json.dumps({"level": level, "msg": msg}))
+            except OSError:
+                pass  # client went away; keep building
 
-            def handle(self_inner, record) -> None:
-                try:
-                    emit(json.dumps({
-                        "level": record.levelname.lower(),
-                        "msg": record.getMessage(),
-                    }))
-                except OSError:
-                    pass  # client went away; keep building
-
-        handler = _EmitHandler()
-        logger = get_logger()
-        logger.addHandler(handler)
-        os.environ["MAKISU_TPU_SHARED_HASH"] = "1"  # batch across builds
-        self._build_lock.acquire()
+        token = log.set_build_sink(sink)
+        locks = self._shared_path_locks(argv)
+        for lock in locks:
+            lock.acquire()
         try:
             return cli.main(argv)
         except SystemExit as e:
@@ -124,8 +130,26 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             emit(json.dumps({"level": "error", "msg": str(e)}))
             return 1
         finally:
-            self._build_lock.release()
-            logger.removeHandler(handler)
+            for lock in reversed(locks):
+                lock.release()
+            log.reset_build_sink(token)
+
+    def _shared_path_locks(self, argv: list[str]) -> list:
+        """Locks for this build's --root/--storage dirs (created on
+        demand, acquired in sorted order so overlapping sets can't
+        deadlock). Builds with disjoint paths share no locks and run
+        fully in parallel."""
+        paths = set()
+        for flag in ("--root", "--storage"):
+            if flag in argv:
+                idx = argv.index(flag)
+                if idx + 1 < len(argv):
+                    paths.add(f"{flag}={os.path.abspath(argv[idx + 1])}")
+            else:
+                paths.add(f"{flag}=<default>")
+        with self._path_locks_mu:
+            return [self._path_locks.setdefault(p, threading.Lock())
+                    for p in sorted(paths)]
 
     def serve_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)

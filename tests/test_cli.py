@@ -121,6 +121,41 @@ def test_cli_diff(tmp_path, fixture_registry, capsys):
     assert "common" not in out
 
 
+def test_cli_diff_whole_config(tmp_path, fixture_registry, capsys):
+    """diff must report differences outside config.* — the reference
+    go-cmp's the entire image config (cmd/diff.go:117-120)."""
+    import json
+
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_CONFIG,
+        Descriptor,
+        Digest,
+        DistributionManifest,
+    )
+    from makisu_tpu.registry import make_test_image
+
+    fixture = fixture_registry(
+        {("library/imga", "latest"): {"f": b"same"}})
+    manifest, config_blob, blobs = make_test_image({"f": b"same"})
+    cfg = json.loads(config_blob)
+    cfg["architecture"] = "arm64"  # identical except architecture
+    new_blob = json.dumps(cfg).encode()
+    new_digest = Digest.of_bytes(new_blob)
+    manifest_b = DistributionManifest(
+        config=Descriptor(MEDIA_TYPE_CONFIG, len(new_blob), new_digest),
+        layers=manifest.layers)
+    blobs_b = dict(blobs)
+    del blobs_b[manifest.config.digest.hex()]
+    blobs_b[new_digest.hex()] = new_blob
+    fixture.serve_image("library/imgb", "latest", manifest_b, blobs_b)
+
+    rc = cli.main(["diff", "imga", "imgb",
+                   "--storage", str(tmp_path / "s")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "architecture" in out and "arm64" in out
+
+
 def test_cli_push_tar(tmp_path, fixture_registry, context):
     fixture = fixture_registry({})
     root = tmp_path / "root"
@@ -166,7 +201,8 @@ def test_cli_build_replicas(tmp_path, fixture_registry, context):
 
 @pytest.mark.parametrize("level", ["no", "speed", "size"])
 def test_build_compression_levels(tmp_path, context, level):
-    import makisu_tpu.tario as tario
+    # Compression is per-build (threaded through BuildContext, never the
+    # tario process globals), so no cross-test restore is needed.
     root = tmp_path / f"root-{level}"
     root.mkdir()
     dest = tmp_path / f"img-{level}.tar"
@@ -176,4 +212,3 @@ def test_build_compression_levels(tmp_path, context, level):
                    "--dest", str(dest)])
     assert rc == 0
     assert dest.exists()
-    tario.set_compression("default")  # restore global for other tests

@@ -85,6 +85,42 @@ def test_concurrent_sessions_through_service(service):
                 payload[c.offset:c.offset + c.length]).digest()
 
 
+def test_cross_build_batches_mix_sessions():
+    """Chunks from two concurrent sessions land in shared device
+    batches — the build-farm win the service exists for. A long linger
+    makes the mixing deterministic: both sessions' chunks are pending
+    before the first batch dispatches."""
+    svc = HashService(linger_seconds=0.5)
+    try:
+        payloads = [np.random.default_rng(300 + i).integers(
+            0, 256, size=150_000, dtype=np.uint8).tobytes()
+            for i in range(2)]
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def build(i):
+            s = ChunkSession(block=64 * 1024, service=svc)
+            barrier.wait()
+            s.update(payloads[i])
+            results[i] = s.finish()
+
+        threads = [threading.Thread(target=build, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, payload in enumerate(payloads):
+            for c in results[i]:
+                assert c.digest == hashlib.sha256(
+                    payload[c.offset:c.offset + c.length]).digest()
+        total_chunks = sum(len(r) for r in results.values())
+        assert svc.batches < total_chunks  # batching happened at all
+        assert svc.cross_build_batches >= 1  # ...and across sessions
+    finally:
+        svc.close()
+
+
 def test_full_build_with_shared_hasher(tmp_path, service):
     """A real BuildPlan through TPUHasher(shared=True)."""
     from makisu_tpu.builder import BuildPlan

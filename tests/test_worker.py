@@ -96,9 +96,72 @@ def test_worker_cli_subcommand(tmp_path):
         proc.kill()
 
 
-def test_concurrent_build_requests_serialize(tmp_path, worker):
-    """Two simultaneous /build requests both succeed (builds serialize
-    inside the worker; process-env step exports must not interleave)."""
+def _file_from_save_tar(tar_path, name):
+    """Read one file's bytes out of a docker-save tar's layers."""
+    import io
+    import json
+    import tarfile
+    with tarfile.open(tar_path) as tf:
+        manifest = json.load(tf.extractfile("manifest.json"))
+        for layer in reversed(manifest[0]["Layers"]):
+            with tarfile.open(fileobj=io.BytesIO(
+                    tf.extractfile(layer).read())) as lt:
+                try:
+                    return lt.extractfile(name).read()
+                except KeyError:
+                    continue
+    raise KeyError(f"{name} not in any layer of {tar_path}")
+
+
+def test_concurrent_build_log_streams_isolated(tmp_path, worker):
+    """Each /build response streams only its own build's log lines —
+    a failing build's RUN output must not leak into another client's
+    stream (per-context log sinks, not a shared logging handler)."""
+    import threading
+
+    lines = {0: [], 1: []}
+    results = {}
+
+    def one(i, dockerfile):
+        ctx = tmp_path / f"lctx{i}"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text(dockerfile)
+        (tmp_path / f"lroot{i}").mkdir()
+        client = WorkerClient(worker.socket_path)
+        results[i] = client.build([
+            "build", str(ctx), "-t", f"w/log{i}:1",
+            "--storage", str(tmp_path / f"ls{i}"),
+            "--root", str(tmp_path / f"lroot{i}"),
+            "--modifyfs"],
+            on_line=lambda p, i=i: lines[i].append(p.get("msg", "")))
+
+    threads = [
+        threading.Thread(target=one, args=(
+            0, "FROM scratch\nRUN echo MARKER-GOOD-BUILD\n"
+               "RUN sleep 0.5\nRUN echo DONE-GOOD\n")),
+        threading.Thread(target=one, args=(
+            1, "FROM scratch\nRUN echo MARKER-BAD-BUILD\n"
+               "RUN sleep 0.2 && echo FAILING-NOW && false\n")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results[0] == 0
+    assert results[1] == 1
+    good = "\n".join(lines[0])
+    bad = "\n".join(lines[1])
+    assert "MARKER-GOOD-BUILD" in good
+    assert "MARKER-BAD-BUILD" in bad and "FAILING-NOW" in bad
+    # No cross-talk in either direction.
+    assert "MARKER-BAD-BUILD" not in good and "FAILING-NOW" not in good
+    assert "MARKER-GOOD-BUILD" not in bad
+
+
+def test_concurrent_builds_run_in_parallel(tmp_path, worker):
+    """Simultaneous /build requests run concurrently with isolated
+    ARG/ENV: each build's RUN step must see its own values (step env
+    lives in the BuildContext, never os.environ)."""
     import threading
 
     results = {}
@@ -107,7 +170,10 @@ def test_concurrent_build_requests_serialize(tmp_path, worker):
         ctx = tmp_path / f"ctx{i}"
         ctx.mkdir()
         (ctx / "Dockerfile").write_text(
-            f"FROM scratch\nCOPY f.txt /f{i}.txt\nENV N={i}\n")
+            f"FROM scratch\n"
+            f"COPY f.txt /f{i}.txt\n"
+            f"ENV BUILD_VAL=value-{i}\n"
+            "RUN echo -n \"$BUILD_VAL\" > val.txt\n")
         (ctx / "f.txt").write_text(str(i))
         (tmp_path / f"root{i}").mkdir()
         client = WorkerClient(worker.socket_path)
@@ -115,13 +181,19 @@ def test_concurrent_build_requests_serialize(tmp_path, worker):
             "build", str(ctx), "-t", f"w/c{i}:1",
             "--storage", str(tmp_path / f"s{i}"),
             "--root", str(tmp_path / f"root{i}"),
+            "--modifyfs",
             "--dest", str(tmp_path / f"out{i}.tar")])
 
-    threads = [threading.Thread(target=one, args=(i,)) for i in range(2)]
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(3)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    assert results == {0: 0, 1: 0}
-    for i in range(2):
-        assert (tmp_path / f"out{i}.tar").exists()
+    assert results == {0: 0, 1: 0, 2: 0}
+    for i in range(3):
+        out = tmp_path / f"out{i}.tar"
+        assert out.exists()
+        # Env isolation: build i's RUN saw its own BUILD_VAL even while
+        # the other builds exported theirs concurrently.
+        assert _file_from_save_tar(
+            str(out), "val.txt") == f"value-{i}".encode()
