@@ -137,6 +137,12 @@ class LayerSink:
     def _finish_chunks(self) -> list[ChunkFingerprint]:
         return []
 
+    def open_tar(self):
+        """Tar writer whose stream feeds this sink (the commit path's
+        single entry point for layer serialization)."""
+        import tarfile
+        return tarfile.open(fileobj=self, mode="w|")
+
     def finish(self) -> LayerCommit:
         if self._closed:
             raise RuntimeError("layer sink already finished")
@@ -168,6 +174,100 @@ class LayerSink:
                            gzip_backend_id=self.backend_id)
 
 
+class _NativeTarWriter:
+    """tarfile.TarFile-shaped writer over the native pipeline: headers
+    are rendered by Python's tarfile (byte-identical PAX output); file
+    content, padding, hashing, and compression run in C++."""
+
+    import tarfile as _tarfile
+    _FMT = (_tarfile.PAX_FORMAT, _tarfile.ENCODING, "surrogateescape")
+
+    def __init__(self, sink: "NativeLayerSink") -> None:
+        self._sink = sink
+        self._offset = 0
+        self._closed = False
+
+    def addfile(self, tarinfo, fileobj=None) -> None:
+        buf = tarinfo.tobuf(*self._FMT)
+        self._sink._handle.write(buf)
+        self._offset += len(buf)
+        if fileobj is not None:
+            remaining = tarinfo.size
+            while remaining > 0:
+                chunk = fileobj.read(min(remaining, 1 << 20))
+                if not chunk:
+                    raise OSError(f"{tarinfo.name}: short read")
+                self._sink._handle.write(chunk)
+                remaining -= len(chunk)
+            pad = (512 - tarinfo.size % 512) % 512
+            if pad:
+                self._sink._handle.write(b"\0" * pad)
+            self._offset += tarinfo.size + pad
+
+    def add_path(self, tarinfo, path: str) -> None:
+        """Fast path: content streams through C++ (no Python bytes)."""
+        buf = tarinfo.tobuf(*self._FMT)
+        self._sink._handle.write(buf)
+        self._sink._handle.write_file(path, tarinfo.size)
+        pad = (512 - tarinfo.size % 512) % 512
+        self._offset += len(buf) + tarinfo.size + pad
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # End of archive exactly as tarfile: two zero blocks, then pad
+        # the stream to a RECORDSIZE multiple (cache-identity-bearing).
+        import tarfile
+        end = b"\0" * (2 * tarfile.BLOCKSIZE)
+        self._offset += len(end)
+        rem = self._offset % tarfile.RECORDSIZE
+        if rem:
+            end += b"\0" * (tarfile.RECORDSIZE - rem)
+        self._sink._handle.write(end)
+
+    def __enter__(self) -> "_NativeTarWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is None:
+            self.close()
+
+
+class NativeLayerSink:
+    """Layer sink backed by native/layersink.cpp: the whole per-byte
+    pipeline (tar framing, dual sha256, gzip) runs in C++. Digest-only —
+    the TPU hasher keeps the Python pipeline because chunk bytes must
+    ship to the device anyway."""
+
+    def __init__(self, out: BinaryIO, backend_id: str | None = None)\
+            -> None:
+        from makisu_tpu import native
+        self.backend_id = backend_id or tario.gzip_backend_id()
+        parts = self.backend_id.split("-")
+        backend, level = parts[0], int(parts[1])
+        block = int(parts[2]) if backend == "pgzip" else 0
+        out.flush()  # nothing buffered may trail the native fd writes
+        self._handle = native.LayerSinkHandle(
+            out.fileno(), backend, level, block or native.DEFAULT_BLOCK)
+
+    def open_tar(self) -> _NativeTarWriter:
+        return _NativeTarWriter(self)
+
+    def write(self, data: bytes) -> int:  # parity with LayerSink
+        self._handle.write(bytes(data))
+        return len(data)
+
+    def finish(self) -> LayerCommit:
+        tar_hex, gz_hex, gz_size, _ = self._handle.finish()
+        self._handle.close()
+        pair = DigestPair(
+            tar_digest=Digest.from_hex(tar_hex),
+            gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, gz_size,
+                                       Digest.from_hex(gz_hex)))
+        return LayerCommit(pair, [], gzip_backend_id=self.backend_id)
+
+
 class Hasher(Protocol):
     """Factory for layer sinks; chosen once per build."""
 
@@ -177,13 +277,30 @@ class Hasher(Protocol):
                    backend_id: str | None = None) -> LayerSink: ...
 
 
+def _native_sink_enabled() -> bool:
+    import os
+    if os.environ.get("MAKISU_TPU_NATIVE_SINK") == "0":
+        return False
+    from makisu_tpu import native
+    return native.layersink_available()
+
+
 class CPUHasher:
-    """Parity with the reference: digests only, no chunking."""
+    """Parity with the reference: digests only, no chunking. Uses the
+    native C++ pipeline when available (MAKISU_TPU_NATIVE_SINK=0 forces
+    the pure-Python path)."""
 
     name = "cpu"
 
     def open_layer(self, out: BinaryIO,
                    backend_id: str | None = None) -> LayerSink:
+        if _native_sink_enabled():
+            try:
+                out.fileno()
+            except (OSError, AttributeError, ValueError):
+                pass  # in-memory outputs (tests) take the Python path
+            else:
+                return NativeLayerSink(out, backend_id=backend_id)
         return LayerSink(out, backend_id=backend_id)
 
 

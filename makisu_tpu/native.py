@@ -5,6 +5,11 @@ capability the reference gets from pgzip (lib/tario/gzip.go:46). Falls
 back cleanly when the shared library hasn't been built; callers check
 ``pgzip_available()``.
 
+``LayerSinkHandle``: the native layer-commit pipeline
+(native/layersink.cpp) — tar content framing, dual SHA-256, and
+deterministic gzip in one C++ pass, replacing Python-side byte shuffling
+on the hot path (reference: lib/builder/step/common.go:35-64).
+
 Build: ``make -C native`` (g++ + zlib; no extra dependencies).
 """
 
@@ -18,12 +23,26 @@ import threading
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpgzip.so")
+_LSK_PATH = os.path.join(_NATIVE_DIR, "liblayersink.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
+_lsk_lib: ctypes.CDLL | None = None
+_lsk_failed = False
 
 DEFAULT_BLOCK = 128 * 1024
+
+
+def _ensure_built(lib_path: str) -> bool:
+    """Run make (mtime-based, so stale .so files rebuild — their output
+    bytes are cache identity) and report whether the library exists."""
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        pass  # no toolchain: a prebuilt library is still usable
+    return os.path.isfile(lib_path)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -31,14 +50,9 @@ def _load() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.isfile(_LIB_PATH):
-            # Best-effort build if the toolchain is present.
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, timeout=120)
-            except (OSError, subprocess.SubprocessError):
-                _load_failed = True
-                return None
+        if not _ensure_built(_LIB_PATH):
+            _load_failed = True
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
             lib.pgz_compress.restype = ctypes.POINTER(ctypes.c_uint8)
@@ -54,13 +68,115 @@ def _load() -> ctypes.CDLL | None:
             if lib.pgz_abi_version() != 1:
                 raise OSError("pgzip ABI mismatch")
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: stale .so missing a symbol — degrade, not
+            # crash (ctypes raises it, not OSError, on dlsym misses).
             _load_failed = True
         return _lib
 
 
 def pgzip_available() -> bool:
     return _load() is not None
+
+
+def _load_lsk() -> ctypes.CDLL | None:
+    global _lsk_lib, _lsk_failed
+    with _lock:
+        if _lsk_lib is not None or _lsk_failed:
+            return _lsk_lib
+        if not _ensure_built(_LSK_PATH):
+            _lsk_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LSK_PATH)
+            lib.lsk_new.restype = ctypes.c_void_p
+            lib.lsk_new.argtypes = [ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_size_t,
+                                    ctypes.c_int]
+            lib.lsk_write.restype = ctypes.c_int
+            lib.lsk_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_size_t]
+            lib.lsk_write_file.restype = ctypes.c_int
+            lib.lsk_write_file.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p,
+                                           ctypes.c_uint64]
+            lib.lsk_finish.restype = ctypes.c_int
+            lib.lsk_finish.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.lsk_free.argtypes = [ctypes.c_void_p]
+            if lib.lsk_abi_version() != 1:
+                raise OSError("layersink ABI mismatch")
+            _lsk_lib = lib
+        except (OSError, AttributeError):
+            _lsk_failed = True
+        return _lsk_lib
+
+
+def layersink_available() -> bool:
+    return _load_lsk() is not None
+
+
+class LayerSinkHandle:
+    """One native layer-commit pipeline bound to an output fd."""
+
+    def __init__(self, out_fd: int, backend: str, level: int,
+                 block_size: int = DEFAULT_BLOCK,
+                 nthreads: int | None = None) -> None:
+        lib = _load_lsk()
+        if lib is None:
+            raise RuntimeError("native layersink library unavailable; "
+                               "run `make -C native`")
+        self._lib = lib
+        if nthreads is None:
+            nthreads = os.cpu_count() or 1
+        self._handle = lib.lsk_new(out_fd, 1 if backend == "pgzip" else 0,
+                                   level, block_size, nthreads)
+        if not self._handle:
+            raise RuntimeError("lsk_new failed")
+
+    def _live(self):
+        if not self._handle:
+            raise RuntimeError("native layer sink already closed")
+        return self._handle
+
+    def write(self, data: bytes) -> None:
+        if self._lib.lsk_write(self._live(), data, len(data)) != 0:
+            raise RuntimeError("native layer sink write failed")
+
+    def write_file(self, path: str, size: int) -> None:
+        rc = self._lib.lsk_write_file(
+            self._live(), os.fsencode(path), size)
+        if rc == -2:
+            raise OSError(f"native layer sink could not read {path}")
+        if rc == -3:
+            raise OSError(f"{path} shrank below its header size {size}")
+        if rc != 0:
+            raise RuntimeError("native layer sink write failed")
+
+    def finish(self) -> tuple[str, str, int, int]:
+        """Returns (tar_sha_hex, gzip_sha_hex, gzip_size, tar_size)."""
+        tar_sha = (ctypes.c_uint8 * 32)()
+        gz_sha = (ctypes.c_uint8 * 32)()
+        gz_size = ctypes.c_uint64(0)
+        tar_size = ctypes.c_uint64(0)
+        rc = self._lib.lsk_finish(self._live(), tar_sha, gz_sha,
+                                  ctypes.byref(gz_size),
+                                  ctypes.byref(tar_size))
+        if rc != 0:
+            raise RuntimeError("native layer sink finish failed")
+        return (bytes(tar_sha).hex(), bytes(gz_sha).hex(),
+                gz_size.value, tar_size.value)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.lsk_free(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        self.close()
 
 
 def pgzip_compress(data: bytes, level: int = 6,

@@ -10,7 +10,6 @@ backend plugs in.
 from __future__ import annotations
 
 import os
-import tarfile
 import tempfile
 import zlib
 
@@ -143,7 +142,7 @@ def commit_layer(ctx: BuildContext, step: BuildStep) -> list[DigestPair]:
         with os.fdopen(fd, "wb") as out:
             sink = ctx.hasher.open_layer(out,
                                          backend_id=ctx.gzip_backend_id)
-            with tarfile.open(fileobj=sink, mode="w|") as tw:
+            with sink.open_tar() as tw:
                 write_diffs(tw)
             layer_commit = sink.finish()
         pair = layer_commit.digest_pair

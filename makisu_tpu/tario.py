@@ -149,9 +149,15 @@ def apply_header(path: str, h: tarfile.TarInfo) -> None:
         os.utime(path, (h.mtime, h.mtime))
 
 
-def write_entry(tw: tarfile.TarFile, src: str, h: tarfile.TarInfo) -> None:
-    """Write one entry; regular-file content streams from ``src``."""
+def write_entry(tw, src: str, h: tarfile.TarInfo) -> None:
+    """Write one entry; regular-file content streams from ``src``.
+    Writers exposing ``add_path`` (the native pipeline) stream content
+    in C++ without the bytes ever entering Python."""
     if h.isreg() and h.size > 0:
+        add_path = getattr(tw, "add_path", None)
+        if add_path is not None:
+            add_path(h, src)
+            return
         with open(src, "rb") as f:
             tw.addfile(h, f)
     else:

@@ -23,6 +23,8 @@
 #include <thread>
 #include <vector>
 
+#include "deflate_common.h"
+
 namespace {
 
 struct Slice {
@@ -34,23 +36,7 @@ struct Slice {
 };
 
 bool deflate_slice(Slice& s, int level) {
-  z_stream zs;
-  std::memset(&zs, 0, sizeof(zs));
-  // windowBits -15: raw deflate (we write the gzip framing ourselves).
-  if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8,
-                   Z_DEFAULT_STRATEGY) != Z_OK) {
-    return false;
-  }
-  s.out.resize(deflateBound(&zs, s.len) + 16);
-  zs.next_in = const_cast<Bytef*>(s.data);
-  zs.avail_in = static_cast<uInt>(s.len);
-  zs.next_out = s.out.data();
-  zs.avail_out = static_cast<uInt>(s.out.size());
-  int rc = deflate(&zs, s.last ? Z_FINISH : Z_SYNC_FLUSH);
-  bool ok = s.last ? (rc == Z_STREAM_END) : (rc == Z_OK);
-  s.out.resize(zs.total_out);
-  deflateEnd(&zs);
-  return ok;
+  return makisu_native::DeflateSlice(s.data, s.len, level, s.last, s.out);
 }
 
 }  // namespace
@@ -120,18 +106,14 @@ uint8_t* pgz_compress(const uint8_t* data, size_t n, int level,
   for (auto& s : slices) total += s.out.size();
   uint8_t* out = static_cast<uint8_t*>(::operator new(total, std::nothrow));
   if (out == nullptr) return nullptr;
-  // Fixed gzip header: magic, deflate, no flags, mtime=0, XFL=0, OS=255.
-  const uint8_t header[10] = {0x1f, 0x8b, 0x08, 0, 0, 0, 0, 0, 0, 0xff};
-  std::memcpy(out, header, 10);
+  std::memcpy(out, makisu_native::kPgzipHeader, 10);
   size_t pos = 10;
   for (auto& s : slices) {
     std::memcpy(out + pos, s.out.data(), s.out.size());
     pos += s.out.size();
   }
-  uint32_t crc32v = static_cast<uint32_t>(crc);
-  uint32_t isize = static_cast<uint32_t>(n & 0xffffffffu);
-  for (int i = 0; i < 4; ++i) out[pos++] = (crc32v >> (8 * i)) & 0xff;
-  for (int i = 0; i < 4; ++i) out[pos++] = (isize >> (8 * i)) & 0xff;
+  makisu_native::GzipTrailer(static_cast<uint32_t>(crc), n, out + pos);
+  pos += 8;
   *out_n = pos;
   return out;
 }
