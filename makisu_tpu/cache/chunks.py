@@ -21,10 +21,16 @@ import hashlib
 import io
 import os
 
+import json
+
 from makisu_tpu import tario
 from makisu_tpu.docker.image import Digest, DigestPair
 from makisu_tpu.storage.cas import CASStore
 from makisu_tpu.utils import logging as log
+
+# Chunk blobs carry their own media type in pin manifests (raw
+# uncompressed tar-stream slices, not gzip layers).
+CHUNK_MEDIA_TYPE = "application/vnd.makisu-tpu.chunk.v1"
 
 
 def _skip(stream, nbytes: int) -> None:
@@ -78,6 +84,54 @@ class ChunkStore:
     def push_remote(self, hex_digest: str) -> None:
         if self.registry is not None:
             self.registry.push_layer(Digest.from_hex(hex_digest))
+
+    def pin_remote(self, layer_hex: str,
+                   chunks: list[tuple[int, int, str]]) -> None:
+        """PUT a per-layer chunk manifest so the registry sees every
+        chunk blob referenced. Without this, chunks ride the blob plane
+        unreferenced by any manifest and every registry's garbage
+        collector eventually deletes them, silently evaporating the
+        distributed half of chunk dedup.
+
+        The pin is a schema2 manifest (tag ``makisu-chunks-<layer>``)
+        whose layers are the chunk blobs and whose config records the
+        pinned layer. Deleting the tag un-pins the chunks — cache
+        retirement maps onto normal registry tag lifecycle."""
+        if self.registry is None or not chunks:
+            return
+        from makisu_tpu.docker.image import (
+            MEDIA_TYPE_CONFIG,
+            Descriptor,
+            DistributionManifest,
+        )
+        config_blob = json.dumps(
+            {"makisuTpuChunkPin": layer_hex},
+            separators=(",", ":")).encode()
+        config_hex = hashlib.sha256(config_blob).hexdigest()
+        if not self.cas.exists(config_hex):
+            self.cas.write_bytes(config_hex, config_blob)
+        self.registry.push_layer(Digest.from_hex(config_hex))
+        manifest = DistributionManifest(
+            config=Descriptor(MEDIA_TYPE_CONFIG, len(config_blob),
+                              Digest.from_hex(config_hex)),
+            layers=[Descriptor(CHUNK_MEDIA_TYPE, length,
+                               Digest.from_hex(hex_digest))
+                    for _, length, hex_digest in chunks])
+        tag = f"makisu-chunks-{layer_hex[:40]}"
+        from makisu_tpu.utils.httputil import HTTPError
+        try:
+            self.registry.push_manifest(tag, manifest)
+        except HTTPError as e:
+            # 400/404 = MANIFEST_BLOB_UNKNOWN: chunks reused from
+            # earlier layers were never pushed to THIS repo. Upload them
+            # (HEAD-skips existing ones) and retry once. Anything else
+            # (auth, media-type rejection) cannot be fixed by pushing
+            # blobs — propagate instead of sweeping every chunk.
+            if e.status not in (400, 404):
+                raise
+            for _, _, hex_digest in chunks:
+                self.push_remote(hex_digest)
+            self.registry.push_manifest(tag, manifest)
 
     def _fetch_remote(self, hex_digest: str) -> bool:
         try:
@@ -202,10 +256,14 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                          cache_id)
             except FileNotFoundError:
                 return
-            if chunk_store.registry is not None and added:
-                # Off the build thread, like layer pushes; only the chunks
-                # this layer introduced.
-                def push_chunks(added=added):
+            if chunk_store.registry is not None:
+                # Off the build thread, like layer pushes: upload the
+                # chunks this layer introduced, then pin the layer's
+                # full chunk set with a manifest (GC safety).
+                layer_hex = pair.gzip_descriptor.digest.hex()
+
+                def push_chunks(added=added, triples=triples,
+                                layer_hex=layer_hex):
                     for hex_digest in added:
                         try:
                             chunk_store.push_remote(hex_digest)
@@ -213,6 +271,11 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                             log.warning("chunk push %s failed: %s",
                                         hex_digest, e)
                             return
+                    try:
+                        chunk_store.pin_remote(layer_hex, triples)
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("chunk pin for %s failed: %s",
+                                    layer_hex, e)
                 import threading
                 t = threading.Thread(target=push_chunks, daemon=True,
                                      name=f"chunkpush-{cache_id}")

@@ -141,7 +141,16 @@ def select_boundaries_np(
     min_size: int = DEFAULT_MIN_SIZE,
     max_size: int = DEFAULT_MAX_SIZE,
 ) -> np.ndarray:
-    """Greedy min/max chunk policy over candidate cut positions (host side).
+    """TEST ORACLE for the min/max chunk policy — not a production path.
+
+    The one production implementation of this policy is
+    ``chunker.cdc.ChunkSession`` (``_cut_to``/``_force_cut``), which
+    applies it streaming. This whole-stream restatement exists so tests
+    can assert the streaming cuts equal the policy applied to the full
+    candidate list (``tests/test_chunker.py::test_session_cuts_match_
+    oracle``); policy changes must land in ChunkSession first and only
+    mirror here. The policy is cache-identity-bearing: changing it
+    invalidates every chunk fingerprint ever cached.
 
     candidates: sorted int array of positions p meaning "cut after byte p"
     n:          stream length

@@ -80,9 +80,14 @@ class RegistryClient:
         self.registry = registry
         self.repository = repository
         self.config = config or config_for(registry, repository, config_map)
+        sec = self.config.security
         self.transport = transport or Transport(
-            tls_verify=self.config.security.tls_verify,
-            ca_cert=self.config.security.ca_cert or None)
+            tls_verify=sec.tls_verify,
+            ca_cert=sec.ca_cert or None,
+            # key=None means the key is embedded in the cert PEM (a
+            # combination load_cert_chain supports; "" is not).
+            client_cert=((sec.client_cert, sec.client_key or None)
+                         if sec.client_cert else None))
         self._token: str | None = None
         self._limiter = _RateLimiter(self.config.push_rate)
 
@@ -230,6 +235,14 @@ class RegistryClient:
             "GET", f"{self._base()}/manifests/{tag}",
             headers={"Accept":
                      f"{MEDIA_TYPE_MANIFEST}, {MEDIA_TYPE_OCI_MANIFEST}"})
+        if tag.startswith("sha256:"):
+            # Pull-by-digest (FROM image@sha256:...): the returned bytes
+            # must hash to the requested digest or the registry lied.
+            actual = Digest.of_bytes(resp.body)
+            if str(actual) != tag:
+                raise ValueError(
+                    f"manifest digest mismatch: asked for {tag}, "
+                    f"got {actual}")
         manifest = DistributionManifest.from_bytes(resp.body)
         if manifest.schema_version != 2:
             raise ValueError(

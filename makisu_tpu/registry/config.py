@@ -24,6 +24,11 @@ DEFAULT_PUSH_CHUNK = 50 * 1024 * 1024     # Content-Range chunk; -1 = whole
 class SecurityConfig:
     tls_verify: bool = True
     ca_cert: str = ""
+    # Mutual-TLS client identity (reference: httputil SendTLS options,
+    # lib/registry/security/security.go:79 — enterprise registries that
+    # authenticate clients by certificate).
+    client_cert: str = ""
+    client_key: str = ""
     basic_user: str = ""
     basic_password: str = ""
     cred_helper: str = ""  # docker-credential-<name> executable suffix
@@ -32,9 +37,12 @@ class SecurityConfig:
     def from_json(d: dict) -> "SecurityConfig":
         tls = d.get("tls") or {}
         basic = d.get("basic") or {}
+        client = tls.get("client") or {}
         return SecurityConfig(
-            tls_verify=not (tls.get("client", {}).get("disabled", False)),
+            tls_verify=not client.get("disabled", False),
             ca_cert=tls.get("ca", {}).get("cert", {}).get("path", ""),
+            client_cert=client.get("cert", {}).get("path", ""),
+            client_key=client.get("key", {}).get("path", ""),
             basic_user=basic.get("username", ""),
             basic_password=basic.get("password", ""),
             cred_helper=d.get("credsStore", ""),

@@ -91,6 +91,25 @@ class RegistryFixture(Transport):
         """Next matching request returns this response (fault injection)."""
         self.overrides.append((method, url_pattern, response))
 
+    def gc(self) -> list[str]:
+        """Delete every blob not referenced by any manifest — what real
+        registries' garbage collectors do. Returns the deleted digests
+        (tests assert pinning kept the right blobs alive)."""
+        referenced: set[str] = set()
+        for raw in self.manifests.values():
+            manifest = json.loads(raw)
+            config = manifest.get("config") or {}
+            if config.get("digest", "").startswith("sha256:"):
+                referenced.add(config["digest"][len("sha256:"):])
+            for layer in manifest.get("layers") or []:
+                digest = layer.get("digest", "")
+                if digest.startswith("sha256:"):
+                    referenced.add(digest[len("sha256:"):])
+        removed = [h for h in self.blobs if h not in referenced]
+        for h in removed:
+            del self.blobs[h]
+        return removed
+
     # -- transport --------------------------------------------------------
 
     def round_trip(self, method, url, headers, body=None, timeout=60.0,

@@ -40,55 +40,66 @@ def walk(src_root: str, blacklist: list[str] | None, fn) -> None:
     syscalls of a listdir+lstat walk."""
     blacklist = blacklist or []
 
-    def visit_dir(path: str) -> None:
-        entries = sorted(os.scandir(path), key=lambda e: e.name)
-        for entry in entries:
-            st = entry.stat(follow_symlinks=False)
-            if should_skip(entry.path, st, blacklist):
-                continue
-            fn(entry.path, st)
-            if entry.is_dir(follow_symlinks=False):
-                visit_dir(entry.path)
+    def sorted_entries(path):
+        return iter(sorted(os.scandir(path), key=lambda e: e.name))
 
     st = os.lstat(src_root)
     if should_skip(src_root, st, blacklist):
         return
     fn(src_root, st)
-    if os.path.isdir(src_root) and not os.path.islink(src_root):
-        visit_dir(src_root)
+    if not os.path.isdir(src_root) or os.path.islink(src_root):
+        return
+    # Explicit iterator stack (not recursion): trees deeper than
+    # Python's ~1000-frame limit must not crash the layer scan. Visit
+    # order is identical to the recursive form — each entry fires in
+    # sorted order, descending into a directory before its siblings.
+    stack = [sorted_entries(src_root)]
+    while stack:
+        entry = next(stack[-1], None)
+        if entry is None:
+            stack.pop()
+            continue
+        st = entry.stat(follow_symlinks=False)
+        if should_skip(entry.path, st, blacklist):
+            continue
+        fn(entry.path, st)
+        if entry.is_dir(follow_symlinks=False):
+            stack.append(sorted_entries(entry.path))
 
 
 def remove_all_children(src_root: str, blacklist: list[str]) -> None:
     """Delete everything under src_root except skipped paths, keeping any
-    directory that still holds a surviving (blacklisted/mounted) child."""
+    directory that still holds a surviving (blacklisted/mounted) child.
 
-    def remove(path: str) -> bool:
+    Iterative (deep trees must not hit the recursion limit): collect
+    candidates depth-first, then delete deepest-first — a directory with
+    a surviving child simply fails its rmdir and is kept, which is
+    exactly the recursive semantics."""
+    stack = [os.path.join(src_root, name) for name in os.listdir(src_root)]
+    order: list[str] = []
+    while stack:
+        path = stack.pop()
         try:
             st = os.lstat(path)
         except OSError:
-            return True  # already gone
+            continue  # already gone
         if should_skip(path, st, blacklist):
-            return False  # kept; ancestors must survive too
-        if not os.path.isdir(path) or os.path.islink(path):
+            continue  # kept; its ancestors fail rmdir and survive too
+        order.append(path)
+        if os.path.isdir(path) and not os.path.islink(path):
             try:
-                os.remove(path)
-                return True
+                names = os.listdir(path)
             except OSError:
-                return False
-        ok = True
-        for name in os.listdir(path):
-            if not remove(os.path.join(path, name)):
-                ok = False
-        if not ok:
-            return False
+                continue
+            stack.extend(os.path.join(path, name) for name in names)
+    for path in reversed(order):
         try:
-            os.rmdir(path)
-            return True
+            if os.path.isdir(path) and not os.path.islink(path):
+                os.rmdir(path)
+            else:
+                os.remove(path)
         except OSError:
-            return False
-
-    for name in os.listdir(src_root):
-        remove(os.path.join(src_root, name))
+            pass  # nonempty dir (surviving child) or racing delete
 
 
 def eval_symlinks(path: str, root: str) -> str:

@@ -171,3 +171,57 @@ def test_chunks_distribute_through_registry_plane(tmp_path):
     assert store_b.layers.exists(layer_hex)
     with store_b.layers.open(layer_hex) as f:
         assert f.read() == evicted  # byte-identical reconstitution
+
+
+def test_chunks_survive_registry_gc(tmp_path):
+    """Registry GC deletes unreferenced blobs; the per-layer chunk-pin
+    manifest must keep chunk blobs referenced so chunk-based
+    reconstitution still works afterwards (the distributed chunk cache
+    must not silently evaporate)."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    payload = np.random.default_rng(9).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "blob.bin").write_bytes(payload)
+
+    def one_builder(tag, store_name, chunk_name):
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/gc",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        attach_chunk_dedup(mgr, str(tmp_path / chunk_name))
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/gc", tag), [], mgr,
+                         stages, allow_modify_fs=False, force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        return manifest, store
+
+    m1, _ = one_builder("a", "store-a", "chunks-a")
+    # A pin manifest exists for the layer.
+    layer_hex = m1.layers[0].digest.hex()
+    pin_tag = f"cache/gc:makisu-chunks-{layer_hex[:40]}"
+    assert pin_tag in fixture.manifests
+    # The layer blob itself is unreferenced (no image manifest was
+    # pushed) — GC deletes it. Chunk blobs survive via the pin.
+    removed = fixture.gc()
+    assert layer_hex in removed
+    assert layer_hex not in fixture.blobs
+    assert fixture.blobs  # pinned chunks survived
+    # A fresh builder reconstitutes the layer purely from GC-surviving
+    # chunks.
+    m2, store_b = one_builder("b", "store-b", "chunks-b")
+    assert [str(l.digest) for l in m1.layers] == \
+        [str(l.digest) for l in m2.layers]
+    assert store_b.layers.exists(layer_hex)

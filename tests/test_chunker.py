@@ -21,6 +21,33 @@ def rand_bytes(n, seed=0):
         0, 256, size=n, dtype=np.uint8).tobytes()
 
 
+@pytest.mark.parametrize("seed,size", [(11, 0), (12, 1), (13, 5_000),
+                                       (14, 131_072), (15, 300_001),
+                                       (16, 64 * 1024)])
+def test_session_cuts_match_oracle(seed, size):
+    """The streaming ChunkSession must apply exactly the whole-stream
+    min/max policy (gear.select_boundaries_np is the declared oracle;
+    the policy is cache-identity-bearing, so the two may never drift)."""
+    data = rand_bytes(size, seed)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    pad = (-len(buf)) % 32
+    padded = np.concatenate([buf, np.zeros(pad, dtype=np.uint8)])
+    bits = gear.unpack_bits_np(
+        np.asarray(gear.gear_bitmap(padded)), len(padded))[:len(buf)]
+    candidates = np.nonzero(bits)[0]
+    oracle = gear.select_boundaries_np(candidates, len(buf))
+
+    session = ChunkSession(block=64 * 1024)
+    # Split writes unevenly to exercise the staging/halo path.
+    for i in range(0, len(data), 50_001):
+        session.update(data[i:i + 50_001])
+    chunks = session.finish()
+    ends = [c.offset + c.length for c in chunks]
+    assert ends == [int(e) for e in oracle if e > 0] or \
+        (len(data) == 0 and ends == [])
+    assert sum(c.length for c in chunks) == len(data)
+
+
 def test_cpu_hasher_digests_match_hashlib():
     payload = rand_bytes(100_000, 1)
     out = io.BytesIO()

@@ -249,6 +249,150 @@ def test_examples_build(env):
         assert m.layers, name
 
 
+def test_context_from_sha256(env):
+    """FROM image@sha256:... pulls by digest and verifies the returned
+    manifest bytes hash to the requested digest (reference context:
+    testdata/build-context/from-sha256)."""
+    base = env.serve_base()
+    digest = str(base.digest())
+    env.fixture.manifests[f"library/base:{digest}"] = base.to_bytes()
+    env.file("f", "f")
+    m = env.build(f"FROM index.docker.io/library/base@{digest}\n"
+                  "COPY f /f\n")
+    members = env.layers(m)
+    assert "etc/base-release" in members
+    assert "f" in members
+
+
+def test_context_from_sha256_wrong_digest_fails(env):
+    base = env.serve_base()
+    bogus = "sha256:" + "ab" * 32
+    env.fixture.manifests[f"library/base:{bogus}"] = base.to_bytes()
+    env.file("f", "f")
+    with pytest.raises(ValueError, match="manifest digest mismatch"):
+        env.build(f"FROM index.docker.io/library/base@{bogus}\nCOPY f /f\n")
+
+
+def test_context_mount_shadowing(env):
+    """Mounted paths are skipped by the scan diff — files under a mount
+    never leak into layers (reference context: build-context/mount;
+    mem_fs.go:193-197 skips mountpoints during scan/untar)."""
+    mnt = env.root / "mnt"
+    mnt.mkdir()
+    (mnt / "secret.txt").write_text("host data")
+    mountinfo.set_mountpoints_for_testing({str(mnt)})
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch\n"
+        "COPY f /f\n"
+        "RUN echo built > result.txt\n",
+        modify_fs=True)
+    members = env.layers(m)
+    assert "result.txt" in members
+    assert not any("secret" in name or name.startswith("mnt")
+                   for name in members)
+
+
+def test_context_remove_base_image_file(env):
+    """RUN rm of a file that came from the BASE image emits a whiteout
+    (reference context: build-context/remove — rm /etc/yum.repos.d/*)."""
+    env.serve_base()  # base provides etc/base-release
+    env.file("f", "f")
+    m = env.build(
+        "FROM index.docker.io/library/base\n"
+        "RUN rm etc/base-release\n",
+        modify_fs=True)
+    members = env.layers(m)
+    assert "etc/.wh.base-release" in members
+
+
+@pytest.mark.skipif(os.getuid() != 0, reason="setuid needs root")
+def test_context_user_change(env):
+    """USER switches the uid RUN executes as, and back (reference
+    context: build-context/user-change)."""
+    import pwd
+    try:
+        pwd.getpwnam("daemon")
+    except KeyError:
+        pytest.skip("no daemon user on this host")
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch\n"
+        "RUN mkdir testdata && chmod a+rwx testdata\n"
+        "RUN id -un > testdata/root_file\n"
+        "USER daemon\n"
+        "RUN id -un > testdata/daemon_file\n"
+        "USER root\n",
+        modify_fs=True)
+    members = env.layers(m)
+    assert members  # layers committed
+    # Read the captured identities back out of the final layer set.
+    contents = {}
+    for desc in m.layers:
+        with env.store.layers.open(desc.digest.hex()) as f:
+            data = gzip.decompress(f.read())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+            for mem in tf:
+                if mem.isreg():
+                    contents[mem.name] = tf.extractfile(mem).read()
+    assert contents["testdata/root_file"].strip() == b"root"
+    assert contents["testdata/daemon_file"].strip() == b"daemon"
+    assert env.config(m).config.user == "root"
+
+
+def test_context_toolchain_from_scratch(env):
+    """Stage 1 compiles a real C binary with the host toolchain; stage 2
+    ships only the artifact (reference context: go-from-scratch)."""
+    import shutil
+    if shutil.which("cc") is None:
+        pytest.skip("no C compiler")
+    env.file("src/main.c",
+             '#include <stdio.h>\n'
+             'int main(void) { puts("built-from-scratch"); return 0; }\n')
+    m = env.build(
+        "FROM scratch AS builder\n"
+        "COPY src /work/src/\n"
+        "RUN cc -O1 -o work/binary work/src/main.c #!COMMIT\n"
+        "\n"
+        "FROM scratch\n"
+        "COPY --from=builder /work/binary /app/binary\n"
+        'ENTRYPOINT ["/app/binary"]\n',
+        modify_fs=True)
+    members = env.layers(m)
+    # Final image holds exactly the artifact tree (+ dirs), no sources.
+    assert "app/binary" in members
+    assert not any("src" in n for n in members)
+    # The artifact is a real executable ELF.
+    for desc in m.layers:
+        with env.store.layers.open(desc.digest.hex()) as f:
+            data = gzip.decompress(f.read())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+            for mem in tf:
+                if mem.name == "app/binary":
+                    blob = tf.extractfile(mem).read()
+                    assert blob[:4] == b"\x7fELF"
+                    assert mem.mode & 0o111  # executable bit survived
+    assert env.config(m).config.entrypoint == ["/app/binary"]
+
+
+def test_context_commit_annotations_empty_layers(env):
+    """#!COMMIT on metadata-only steps commits empty layers in sequence
+    (reference context: mount, phase3 — 'generate a few empty layers')."""
+    env.file("f", "f")
+    m = env.build(
+        "FROM scratch\n"
+        "RUN mkdir test #!COMMIT\n"
+        "WORKDIR /test #!COMMIT\n"
+        "RUN ls . #!COMMIT\n"
+        "COPY f /test/f\n",
+        modify_fs=True)
+    cfg = env.config(m)
+    assert len(cfg.rootfs.diff_ids) == len(m.layers)
+    members = env.layers(m)
+    assert "test/f" in members
+    assert cfg.config.working_dir == "/test"
+
+
 def test_history_has_empty_layer_entries(env):
     env.file("f", "f")
     m = env.build("FROM scratch\nCOPY f /f\nLABEL a=b\nCMD [\"x\"]\n")

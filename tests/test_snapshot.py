@@ -417,3 +417,30 @@ def test_long_paths_roundtrip(tmp_path):
         fs2.update_from_tar(tf, untar=True)
     restored = str(f).replace(str(tmp_path), str(dest))
     assert open(restored).read() == "deep"
+
+
+def test_walk_survives_very_deep_trees(tmp_path):
+    """Trees deeper than Python's recursion limit must scan and clean
+    without RecursionError (walk and remove_all_children are iterative)."""
+    import importlib
+    walk_mod = importlib.import_module("makisu_tpu.snapshot.walk")
+
+    depth = 1200  # > default recursion limit; path stays under PATH_MAX
+    deep = str(tmp_path)
+    for _ in range(depth):
+        deep = deep + "/d"
+        os.mkdir(deep)  # (pathlib's parents=True recurses — avoid it)
+    with open(deep + "/leaf.txt", "w") as f:
+        f.write("bottom")
+
+    seen = []
+    walk_mod.walk(str(tmp_path), [], lambda p, st: seen.append(p))
+    assert any(p.endswith("leaf.txt") for p in seen)
+    assert len(seen) == depth + 2  # root + dirs + leaf
+
+    # Order parity with the recursive form: parents before children.
+    for parent, child in zip(seen[1:], seen[2:]):
+        assert child.startswith(parent)
+
+    walk_mod.remove_all_children(str(tmp_path), [])
+    assert os.listdir(tmp_path) == []
