@@ -1,0 +1,49 @@
+# Shippable makisu-tpu image (reference: /root/reference/Dockerfile —
+# a scratch image with the binary + cred helpers at /makisu-internal/,
+# consumed by k8s build jobs).
+#
+# The runtime is Python, so the final stage is a slim Python base rather
+# than scratch; the layout contract is the same: the builder entrypoint
+# and docker-credential-* helpers live under /makisu-internal/ (the
+# cred-helper lookup probes that directory first —
+# makisu_tpu/registry/client.py:_exec_cred_helper).
+#
+# Build:  docker build -t makisu-tpu .
+#         (or dogfood: makisu-tpu build . -t makisu-tpu --modifyfs)
+# Run:    docker run makisu-tpu build /context -t repo/app:tag ...
+# Worker: docker run -v /shared:/shared makisu-tpu worker --socket \
+#         /shared/makisu.sock
+
+FROM python:3.12-slim AS builder
+
+# Native pieces need a toolchain + zlib headers; the wheel ships the
+# prebuilt .so files so the final stage stays slim.
+RUN apt-get update && \
+    apt-get install -y --no-install-recommends g++ make zlib1g-dev && \
+    rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml ./
+COPY makisu_tpu ./makisu_tpu
+COPY native ./native
+RUN make -C native && pip install --no-cache-dir .
+
+FROM python:3.12-slim
+
+# JAX CPU backend for the accelerator code paths; on TPU hosts the
+# libtpu plugin comes from the host image/driver instead.
+RUN pip install --no-cache-dir "jax[cpu]" numpy
+
+COPY --from=builder /usr/local/lib/python3.12/site-packages \
+    /usr/local/lib/python3.12/site-packages
+COPY --from=builder /usr/local/bin/makisu-tpu \
+    /usr/local/bin/makisu-tpu-mkrootfs /usr/local/bin/
+COPY --from=builder /src/native/*.so /makisu-internal/native/
+
+# /makisu-internal/ mirrors the reference layout: entrypoint symlink and
+# the directory where docker-credential-<helper> binaries are baked or
+# mounted (lib/registry/security/security.go:39).
+RUN mkdir -p /makisu-internal && \
+    ln -s /usr/local/bin/makisu-tpu /makisu-internal/makisu-tpu
+ENV MAKISU_TPU_NATIVE_DIR=/makisu-internal/native
+
+ENTRYPOINT ["/makisu-internal/makisu-tpu"]

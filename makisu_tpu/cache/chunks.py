@@ -32,6 +32,10 @@ from makisu_tpu.utils import logging as log
 # uncompressed tar-stream slices, not gzip layers).
 CHUNK_MEDIA_TYPE = "application/vnd.makisu-tpu.chunk.v1"
 
+# Chunks per pin manifest: ~140 bytes/descriptor keeps each manifest
+# near 2.8MB, under distribution's 4MiB payload cap.
+PIN_SHARD_CHUNKS = 20_000
+
 
 def _skip(stream, nbytes: int) -> None:
     """Advance a non-seekable decompression stream by nbytes."""
@@ -93,10 +97,14 @@ class ChunkStore:
         collector eventually deletes them, silently evaporating the
         distributed half of chunk dedup.
 
-        The pin is a schema2 manifest (tag ``makisu-chunks-<layer>``)
-        whose layers are the chunk blobs and whose config records the
-        pinned layer. Deleting the tag un-pins the chunks — cache
-        retirement maps onto normal registry tag lifecycle."""
+        The pin is one or more schema2 manifests (tags
+        ``makisu-chunks-<layer>[-<shard>]``) whose layers are the chunk
+        blobs and whose config records the pinned layer. Large layers
+        shard across multiple pin manifests so no single manifest
+        exceeds registries' payload limits (distribution caps manifests
+        at 4MiB; a multi-GB layer has 100k+ chunks). Deleting the tags
+        un-pins the chunks — cache retirement maps onto normal registry
+        tag lifecycle."""
         if self.registry is None or not chunks:
             return
         from makisu_tpu.docker.image import (
@@ -111,25 +119,34 @@ class ChunkStore:
         if not self.cas.exists(config_hex):
             self.cas.write_bytes(config_hex, config_blob)
         self.registry.push_layer(Digest.from_hex(config_hex))
-        manifest = DistributionManifest(
-            config=Descriptor(MEDIA_TYPE_CONFIG, len(config_blob),
-                              Digest.from_hex(config_hex)),
-            layers=[Descriptor(CHUNK_MEDIA_TYPE, length,
-                               Digest.from_hex(hex_digest))
-                    for _, length, hex_digest in chunks])
-        tag = f"makisu-chunks-{layer_hex[:40]}"
+        config_desc = Descriptor(MEDIA_TYPE_CONFIG, len(config_blob),
+                                 Digest.from_hex(config_hex))
+        for shard_index, start in enumerate(
+                range(0, len(chunks), PIN_SHARD_CHUNKS)):
+            shard = chunks[start:start + PIN_SHARD_CHUNKS]
+            manifest = DistributionManifest(
+                config=config_desc,
+                layers=[Descriptor(CHUNK_MEDIA_TYPE, length,
+                                   Digest.from_hex(hex_digest))
+                        for _, length, hex_digest in shard])
+            tag = f"makisu-chunks-{layer_hex[:40]}"
+            if start:
+                tag += f"-{shard_index}"
+            self._push_pin_manifest(tag, manifest, shard)
+
+    def _push_pin_manifest(self, tag: str, manifest, shard) -> None:
         from makisu_tpu.utils.httputil import HTTPError
         try:
             self.registry.push_manifest(tag, manifest)
         except HTTPError as e:
-            # 400/404 = MANIFEST_BLOB_UNKNOWN: chunks reused from
-            # earlier layers were never pushed to THIS repo. Upload them
-            # (HEAD-skips existing ones) and retry once. Anything else
-            # (auth, media-type rejection) cannot be fixed by pushing
-            # blobs — propagate instead of sweeping every chunk.
-            if e.status not in (400, 404):
+            # BLOB_UNKNOWN: chunks reused from earlier layers were never
+            # pushed to THIS repo. Upload them (HEAD-skips existing
+            # ones) and retry once. Anything else (auth, media-type or
+            # size rejection) cannot be fixed by pushing blobs —
+            # propagate instead of sweeping every chunk.
+            if e.status != 404 and b"BLOB_UNKNOWN" not in e.body:
                 raise
-            for _, _, hex_digest in chunks:
+            for _, _, hex_digest in shard:
                 self.push_remote(hex_digest)
             self.registry.push_manifest(tag, manifest)
 

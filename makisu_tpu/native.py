@@ -20,8 +20,11 @@ import os
 import subprocess
 import threading
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "native")
+# Containerized installs (Dockerfile) bake the prebuilt .so files at
+# /makisu-internal/native and point this env var there; source checkouts
+# use the sibling native/ directory.
+_NATIVE_DIR = os.environ.get("MAKISU_TPU_NATIVE_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpgzip.so")
 _LSK_PATH = os.path.join(_NATIVE_DIR, "liblayersink.so")
 

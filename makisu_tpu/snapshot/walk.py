@@ -87,11 +87,11 @@ def remove_all_children(src_root: str, blacklist: list[str]) -> None:
             continue  # kept; its ancestors fail rmdir and survive too
         order.append(path)
         if os.path.isdir(path) and not os.path.islink(path):
-            try:
-                names = os.listdir(path)
-            except OSError:
-                continue
-            stack.extend(os.path.join(path, name) for name in names)
+            # Unguarded, like the recursive form: an unreadable dir must
+            # fail the cleanup loudly — silently keeping its contents
+            # would leak stage-1 files into stage-2 layers.
+            stack.extend(os.path.join(path, name)
+                         for name in os.listdir(path))
     for path in reversed(order):
         try:
             if os.path.isdir(path) and not os.path.islink(path):

@@ -55,7 +55,10 @@ class Transport:
 
     def __init__(self, tls_verify: bool = True,
                  ca_cert: str | None = None,
-                 client_cert: tuple[str, str] | None = None) -> None:
+                 client_cert: "tuple[str, str | None] | None" = None)\
+            -> None:
+        # client_cert: (cert_path, key_path); key None = embedded in the
+        # cert PEM (load_cert_chain semantics).
         self.tls_verify = tls_verify
         self.ca_cert = ca_cert
         self.client_cert = client_cert

@@ -1,0 +1,45 @@
+"""The shipped Dockerfile: parseable by our own frontend and honoring
+the /makisu-internal/ layout contract (reference: Dockerfile +
+security.go:39 cred-helper path)."""
+
+import os
+
+from makisu_tpu.dockerfile import parse_file
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dockerfile_parses_with_own_frontend():
+    with open(os.path.join(_REPO, "Dockerfile")) as f:
+        stages = parse_file(f.read())
+    assert len(stages) == 2
+    assert stages[0].from_directive.alias == "builder"
+    names = [type(d).__name__ for stage in stages
+             for d in stage.directives]
+    assert "EntrypointDirective" in names
+    assert "CopyDirective" in names and "RunDirective" in names
+
+
+def test_dockerfile_layout_contract():
+    """Entrypoint and cred-helper dir live under /makisu-internal/, and
+    the native env override points at the baked .so directory."""
+    with open(os.path.join(_REPO, "Dockerfile")) as f:
+        text = f.read()
+    assert "/makisu-internal/makisu-tpu" in text
+    assert 'ENTRYPOINT ["/makisu-internal/makisu-tpu"]' in text
+    assert "MAKISU_TPU_NATIVE_DIR=/makisu-internal/native" in text
+
+
+def test_native_dir_env_override(monkeypatch, tmp_path):
+    """MAKISU_TPU_NATIVE_DIR redirects the ctypes loader (container
+    installs have no sibling native/ checkout)."""
+    import importlib
+
+    import makisu_tpu.native as native
+    monkeypatch.setenv("MAKISU_TPU_NATIVE_DIR", str(tmp_path))
+    reloaded = importlib.reload(native)
+    try:
+        assert reloaded._NATIVE_DIR == str(tmp_path)
+    finally:
+        monkeypatch.delenv("MAKISU_TPU_NATIVE_DIR")
+        importlib.reload(native)
