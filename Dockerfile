@@ -16,8 +16,10 @@
 
 FROM python:3.12-slim AS builder
 
-# Native pieces need a toolchain + zlib headers; the wheel ships the
-# prebuilt .so files so the final stage stays slim.
+# Native pieces need a toolchain + zlib headers. The wheel is pure
+# Python; the .so files reach the final stage ONLY via the explicit
+# COPY to /makisu-internal/native below (keep that line and the
+# MAKISU_TPU_NATIVE_DIR env together).
 RUN apt-get update && \
     apt-get install -y --no-install-recommends g++ make zlib1g-dev && \
     rm -rf /var/lib/apt/lists/*

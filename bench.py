@@ -87,10 +87,53 @@ def _device_throughput_gbps() -> tuple[float, str]:
     return total_bytes / elapsed / 1e9, backend
 
 
+def _gear_ab_gbps() -> dict:
+    """Isolated gear-scan A/B: the XLA log-doubling path vs the fused
+    Pallas kernel, same bytes. Only meaningful on a real device (the
+    Pallas kernel runs compiled, not interpret)."""
+    import jax
+
+    from makisu_tpu.ops import gear, gear_pallas
+
+    n = 32 * 1024 * 1024
+    buf = np.random.default_rng(2).integers(0, 256, size=n, dtype=np.uint8)
+    iters = 5
+
+    batched = jax.device_put(buf.reshape(8, -1))
+    jax.block_until_ready(gear.gear_bitmap(batched))
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = gear.gear_bitmap(batched)
+    jax.block_until_ready(out)
+    xla = iters * n / (time.perf_counter() - start) / 1e9
+
+    rows, _ = gear_pallas.stage_rows(buf, 0, n)
+    rows_dev = jax.device_put(rows)
+    jax.block_until_ready(gear_pallas.gear_bitmap_rows(rows_dev))
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = gear_pallas.gear_bitmap_rows(rows_dev)
+    jax.block_until_ready(out)
+    pallas = iters * n / (time.perf_counter() - start) / 1e9
+    return {"gear_xla_gbps": round(xla, 3),
+            "gear_pallas_gbps": round(pallas, 3)}
+
+
 def _child_main() -> int:
-    """Subprocess entry: measure on whatever backend JAX initializes."""
+    """Subprocess entry: measure on whatever backend JAX initializes.
+
+    The main pipeline number prints FIRST (flushed) so that if the
+    experimental Pallas kernel crashes the process on real hardware,
+    the parent still reads the XLA result from the earlier line."""
     value, backend = _device_throughput_gbps()
-    print(json.dumps({"gbps": value, "backend": backend}))
+    record = {"gbps": value, "backend": backend}
+    print(json.dumps(record), flush=True)
+    if backend != "cpu":
+        try:
+            record.update(_gear_ab_gbps())
+        except Exception as e:  # noqa: BLE001 - A/B is best-effort
+            record["pallas_error"] = str(e)[:300]
+        print(json.dumps(record), flush=True)
     return 0
 
 
@@ -101,24 +144,33 @@ def _run_child(env_overrides: dict[str, str],
     init (tunnel never answers) recoverable: we kill and fall back."""
     env = dict(os.environ)
     env.update(env_overrides)
+    stdout, stderr, failure = "", "", ""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--device"],
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=_REPO)
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout:.0f}s (backend init hang?)"
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        return None, f"rc={proc.returncode}: " + " | ".join(tail[-3:])
-    for line in reversed(proc.stdout.strip().splitlines()):
+        stdout, stderr = proc.stdout or "", proc.stderr or ""
+        if proc.returncode != 0:
+            tail = (stderr or stdout).strip().splitlines()
+            failure = f"rc={proc.returncode}: " + " | ".join(tail[-3:])
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
+                  else e.stdout) or ""
+        failure = f"timeout after {timeout:.0f}s (backend init hang?)"
+    # Scan stdout even after a crash/timeout: the child flushes its XLA
+    # result line BEFORE attempting the experimental Pallas kernel, so a
+    # kernel segfault must not cost us the measured number.
+    for line in reversed(stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
         if isinstance(parsed, dict) and "gbps" in parsed:
+            if failure:
+                parsed.setdefault("pallas_error", failure)
             return parsed, ""
-    return None, "no JSON result line in child output"
+    return None, failure or "no JSON result line in child output"
 
 
 def main() -> int:
@@ -142,6 +194,9 @@ def main() -> int:
                         if result else 0.0),
         "backend": result["backend"] if result else "none",
     }
+    for extra in ("gear_xla_gbps", "gear_pallas_gbps", "pallas_error"):
+        if result and extra in result:
+            record[extra] = result[extra]
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))

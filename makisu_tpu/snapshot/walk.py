@@ -87,11 +87,15 @@ def remove_all_children(src_root: str, blacklist: list[str]) -> None:
             continue  # kept; its ancestors fail rmdir and survive too
         order.append(path)
         if os.path.isdir(path) and not os.path.islink(path):
-            # Unguarded, like the recursive form: an unreadable dir must
-            # fail the cleanup loudly — silently keeping its contents
-            # would leak stage-1 files into stage-2 layers.
-            stack.extend(os.path.join(path, name)
-                         for name in os.listdir(path))
+            # An unreadable dir (EACCES) must fail the cleanup loudly —
+            # silently keeping its contents would leak stage-1 files
+            # into stage-2 layers. A dir deleted since lstat is a benign
+            # race (the delete loop below tolerates it too).
+            try:
+                names = os.listdir(path)
+            except FileNotFoundError:
+                continue
+            stack.extend(os.path.join(path, name) for name in names)
     for path in reversed(order):
         try:
             if os.path.isdir(path) and not os.path.islink(path):
