@@ -155,8 +155,8 @@ def _run_child(env_overrides: dict[str, str],
             tail = (stderr or stdout).strip().splitlines()
             failure = f"rc={proc.returncode}: " + " | ".join(tail[-3:])
     except subprocess.TimeoutExpired as e:
-        stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
-                  else e.stdout) or ""
+        stdout = (e.stdout.decode(errors="replace")
+                  if isinstance(e.stdout, bytes) else e.stdout) or ""
         failure = f"timeout after {timeout:.0f}s (backend init hang?)"
     # Scan stdout even after a crash/timeout: the child flushes its XLA
     # result line BEFORE attempting the experimental Pallas kernel, so a

@@ -226,8 +226,14 @@ class MemFS:
             child = node.children.get(parts[i])
             if child is None:
                 break
-            self._apply_entry(
-                layer.add_header(child.src, child.dst, child.hdr))
+            # Skip the re-add when this exact ancestor entry is already
+            # in the layer (every descendant repeats its whole chain;
+            # on a cold scan that is O(depth) redundant header work).
+            existing = layer.entries.get(child.dst)
+            if not (isinstance(existing, ContentEntry)
+                    and existing.hdr is child.hdr):
+                self._apply_entry(
+                    layer.add_header(child.src, child.dst, child.hdr))
             if child.hdr.isdir():
                 node = child
                 last_dir = child

@@ -93,8 +93,8 @@ def remove_all_children(src_root: str, blacklist: list[str]) -> None:
             # race (the delete loop below tolerates it too).
             try:
                 names = os.listdir(path)
-            except FileNotFoundError:
-                continue
+            except (FileNotFoundError, NotADirectoryError):
+                continue  # deleted/replaced since lstat: benign race
             stack.extend(os.path.join(path, name) for name in names)
     for path in reversed(order):
         try:

@@ -7,6 +7,7 @@ bind-mounts read-only.
 
 from __future__ import annotations
 
+import functools
 import os
 
 DEFAULT_STORAGE_DIR = "/makisu-storage"
@@ -30,9 +31,14 @@ DEFAULT_BLACKLIST = [
 ]
 
 
+@functools.lru_cache(maxsize=65536)
 def abs_path(p: str) -> str:
     """Normalize to an absolute path with a leading '/'. Does not resolve
-    symlinks (layer paths are logical, not host-resolved)."""
+    symlinks (layer paths are logical, not host-resolved).
+
+    Memoized: scans normalize the same paths many times over (each
+    blacklist entry per visited file, ancestors per descendant); the
+    cache turns the string work into a dict hit on the hot loop."""
     p = os.path.normpath("/" + p)
     if p.startswith("//"):  # POSIX normpath preserves a double leading slash
         p = "/" + p.lstrip("/")
