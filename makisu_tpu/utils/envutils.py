@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import re
 
-_VAR = re.compile(r"\$(\w+|\{[^}]*\})")
+# re.ASCII matches posixpath._varprog: non-ASCII "word" characters are
+# not variable names to expandvars, so not to us either.
+_VAR = re.compile(r"\$(\w+|\{[^}]*\})", re.ASCII)
 
 
 def expand(text: str, env: dict[str, str]) -> str:

@@ -84,3 +84,41 @@ def test_run_without_modifyfs_fails(tmp_path):
                      force_commit=False)
     with pytest.raises(RuntimeError):
         plan.execute()
+
+
+def test_envutils_expand_matches_posix_expandvars():
+    """envutils.expand(text, env) must keep os.path.expandvars semantics
+    (steps moved from os.environ mutation to per-build env dicts; the
+    expansion rules are observable behavior)."""
+    import os
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    from makisu_tpu.utils import envutils
+
+    env = {"FOO": "foo-val", "BAR": "bar val", "EMPTY": "", "N1": "x",
+           "ÉVAR": "accented"}
+
+    token = st.sampled_from(
+        ["$FOO", "${FOO}", "$BAR", "${EMPTY}", "$MISSING", "${MISSING}",
+         "$N1", "${N1}", "$", "${", "}", "${}", "$$FOO", "literal",
+         "a/b", " ", "$FOO$BAR", "${FOO}tail", "pre${BAR}",
+         "$ÉVAR", "${ÉVAR}"])  # non-ASCII names are NOT variables
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.lists(token, max_size=8).map("".join))
+    def check(text):
+        assert envutils.expand(text, env) == os.path.expandvars(text), text
+
+    # Swap the process environ ONCE around the whole property run (other
+    # tests' daemon threads read os.environ; 200 cleared windows would
+    # be a flake vector).
+    saved = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env)
+    try:
+        check()
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)

@@ -113,6 +113,42 @@ def _file_from_save_tar(tar_path, name):
     raise KeyError(f"{name} not in any layer of {tar_path}")
 
 
+def test_builds_sharing_root_serialize(tmp_path, worker):
+    """Builds with the same --root must not interleave on the
+    filesystem: the per-path locks serialize exactly those builds."""
+    import threading
+
+    shared_root = tmp_path / "shared-root"
+    shared_root.mkdir()
+    results = {}
+
+    def one(i):
+        ctx = tmp_path / f"sctx{i}"
+        ctx.mkdir()
+        # Each build RUNs long enough to overlap, writes a marker, and
+        # then asserts no other build's marker appeared meanwhile (the
+        # stage cleanup wipes the root between builds).
+        (ctx / "Dockerfile").write_text(
+            "FROM scratch\n"
+            f"RUN echo {i} > who.txt && sleep 0.4 && "
+            f"test \"$(cat who.txt)\" = \"{i}\"\n")
+        client = WorkerClient(worker.socket_path)
+        results[i] = client.build([
+            "build", str(ctx), "-t", f"w/s{i}:1",
+            "--storage", str(tmp_path / f"ss{i}"),
+            "--root", str(shared_root),
+            "--modifyfs"])
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Without serialization the concurrent RUNs would clobber who.txt
+    # and at least one `test` would fail.
+    assert results == {0: 0, 1: 0}
+
+
 def test_concurrent_build_log_streams_isolated(tmp_path, worker):
     """Each /build response streams only its own build's log lines —
     a failing build's RUN output must not leak into another client's
