@@ -1,0 +1,187 @@
+"""BASELINE.md benchmark configs, scaled to the current host.
+
+Runs the config list from BASELINE.md (CPU-feasible subset — configs
+needing a real chip or 10GB of disk are scaled down and labeled) and
+prints one JSON object per config. Usage:
+
+    JAX_PLATFORMS=cpu python benchmarks/configs.py [--quick]
+
+Config mapping:
+  1. simple single-COPY build                  (as written)
+  2. self-build of the repo's own Dockerfile   (parse+plan only: the
+     base image needs network; we verify our own frontend handles it)
+  3. node_modules-style small-file stress      (50k files, ~400MB)
+  4. monorepo + distributed cache warm rebuild (30k files, FS KV)
+  5. concurrent worker builds sharing the hash service (8 builds,
+     cross-build batching observed)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _tree(root: str, files: int, lo: int, hi: int, seed: int) -> int:
+    rnd = random.Random(seed)
+    total = 0
+    for i in range(files):
+        d = os.path.join(root, f"pkg{i % 200}", f"node_modules{i % 13}")
+        os.makedirs(d, exist_ok=True)
+        n = rnd.randint(lo, hi)
+        with open(os.path.join(d, f"m{i}.js"), "wb") as f:
+            f.write(rnd.randbytes(n))
+        total += n
+    return total
+
+
+def _build(ctx: str, storage: str, root: str, *extra: str) -> float:
+    os.makedirs(root, exist_ok=True)
+    start = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "makisu_tpu.cli", "build", ctx,
+         "-t", "bench/cfg:1", "--storage", storage, "--root", root,
+         *extra],
+        capture_output=True, cwd=_REPO)
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr.decode()[-500:])
+    return time.time() - start
+
+
+def config1(work: str, quick: bool) -> dict:
+    ctx = os.path.join(work, "c1")
+    os.makedirs(ctx)
+    with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+        f.write("FROM scratch\nCOPY . /app/\n")
+    nbytes = _tree(ctx, 300 if quick else 3000, 2000, 30000, 1)
+    elapsed = _build(ctx, os.path.join(work, "s1"),
+                     os.path.join(work, "r1"))
+    return {"config": 1, "desc": "simple single-COPY build",
+            "files": 300 if quick else 3000, "mb": round(nbytes / 1e6, 1),
+            "seconds": round(elapsed, 2)}
+
+
+def config2(work: str, quick: bool) -> dict:
+    from makisu_tpu.dockerfile import parse_file
+    start = time.time()
+    with open(os.path.join(_REPO, "Dockerfile")) as f:
+        stages = parse_file(f.read())
+    return {"config": 2, "desc": "self-Dockerfile frontend (parse+plan; "
+            "base pull needs network)", "stages": len(stages),
+            "seconds": round(time.time() - start, 4)}
+
+
+def config3(work: str, quick: bool) -> dict:
+    ctx = os.path.join(work, "c3")
+    os.makedirs(ctx)
+    with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+        f.write("FROM scratch\nCOPY . /app/\n")
+    files = 5000 if quick else 50000
+    nbytes = _tree(ctx, files, 2000, 14000, 3)
+    elapsed = _build(ctx, os.path.join(work, "s3"),
+                     os.path.join(work, "r3"))
+    return {"config": 3, "desc": "node_modules small-file stress",
+            "files": files, "mb": round(nbytes / 1e6, 1),
+            "seconds": round(elapsed, 2),
+            "files_per_s": round(files / elapsed)}
+
+
+def config4(work: str, quick: bool) -> dict:
+    ctx = os.path.join(work, "c4")
+    os.makedirs(ctx)
+    with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+        f.write("FROM scratch\nCOPY . /app/\n")
+    files = 3000 if quick else 30000
+    nbytes = _tree(ctx, files, 4000, 18000, 4)
+    storage = os.path.join(work, "s4")
+    cold = _build(ctx, storage, os.path.join(work, "r4a"))
+    warm = _build(ctx, storage, os.path.join(work, "r4b"))
+    return {"config": 4, "desc": "monorepo + FS-KV cache warm rebuild",
+            "files": files, "mb": round(nbytes / 1e6, 1),
+            "cold_seconds": round(cold, 2), "warm_seconds": round(warm, 2),
+            "warm_speedup": round(cold / warm, 2)}
+
+
+def config5(work: str, quick: bool) -> dict:
+    import threading
+
+    from makisu_tpu.chunker import service as svc_mod
+    from makisu_tpu.utils import logging as mlog
+    from makisu_tpu.utils import mountinfo
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    mlog.configure("error", "console", "stderr")  # keep stdout JSON-only
+    mountinfo.set_mountpoints_for_testing(set())
+    os.environ["MAKISU_TPU_SHARED_HASH"] = "1"
+    server = WorkerServer(os.path.join(work, "w.sock"))
+    server.serve_background()
+    jobs = 4 if quick else 8
+    for i in range(jobs):
+        ctx = os.path.join(work, f"c5-{i}")
+        os.makedirs(ctx)
+        with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+            f.write("FROM scratch\nCOPY . /app/\n")
+        _tree(ctx, 40, 4000, 30000, 50 + i)
+    results = {}
+
+    def one(i):
+        client = WorkerClient(server.socket_path)
+        results[i] = client.build([
+            "--log-level", "error", "--log-output", "stderr",
+            "build", os.path.join(work, f"c5-{i}"),
+            "-t", f"bench/w{i}:1", "--hasher", "tpu",
+            "--storage", os.path.join(work, f"s5-{i}"),
+            "--root", os.path.join(work, f"r5-{i}")])
+
+    for i in range(jobs):
+        os.makedirs(os.path.join(work, f"r5-{i}"))
+    start = time.time()
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.time() - start
+    server.shutdown()
+    server.server_close()
+    svc = svc_mod._global_service
+    return {"config": 5, "desc": "concurrent worker builds, shared hash "
+            "service (in-process analog of 64-job farm)",
+            "jobs": jobs,
+            "ok": (len(results) == jobs
+                   and all(c == 0 for c in results.values())),
+            "seconds": round(elapsed, 2),
+            "device_batches": svc.batches if svc else None,
+            "cross_build_batches": svc.cross_build_batches if svc else None}
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv[1:]
+    out = []
+    for number, fn in enumerate((config1, config2, config3, config4,
+                                 config5), start=1):
+        work = tempfile.mkdtemp(prefix=f"bench-{fn.__name__}-")
+        try:
+            rec = fn(work, quick)
+        except Exception as e:  # noqa: BLE001 - record, keep going
+            rec = {"config": number, "error": str(e)[:300]}
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+        print(json.dumps(rec))
+        out.append(rec)
+    return 1 if any("error" in r or r.get("ok") is False
+                    for r in out) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
