@@ -28,3 +28,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+
+import pytest  # noqa: E402
+
+from makisu_tpu.utils import mountinfo  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_mounts():
+    """Tmp build roots must not inherit the host mount table's skip
+    rules (one definition for every suite; tests needing specific
+    mountpoints override inside the test body)."""
+    mountinfo.set_mountpoints_for_testing(set())
+    yield
+    mountinfo.set_mountpoints_for_testing(None)

@@ -17,14 +17,8 @@ from makisu_tpu.context import BuildContext
 from makisu_tpu.docker.image import ImageConfig, ImageName
 from makisu_tpu.dockerfile import parse_file
 from makisu_tpu.storage import ImageStore
-from makisu_tpu.utils import mountinfo
 
 
-@pytest.fixture(autouse=True)
-def _no_mounts():
-    mountinfo.set_mountpoints_for_testing(set())
-    yield
-    mountinfo.set_mountpoints_for_testing(None)
 
 
 @pytest.fixture

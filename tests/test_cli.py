@@ -9,14 +9,8 @@ import tarfile
 import pytest
 
 from makisu_tpu import cli
-from makisu_tpu.utils import mountinfo
 
 
-@pytest.fixture(autouse=True)
-def _no_mounts():
-    mountinfo.set_mountpoints_for_testing(set())
-    yield
-    mountinfo.set_mountpoints_for_testing(None)
 
 
 @pytest.fixture

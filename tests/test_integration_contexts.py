@@ -27,11 +27,6 @@ from makisu_tpu.storage import ImageStore
 from makisu_tpu.utils import mountinfo
 
 
-@pytest.fixture(autouse=True)
-def _no_mounts():
-    mountinfo.set_mountpoints_for_testing(set())
-    yield
-    mountinfo.set_mountpoints_for_testing(None)
 
 
 class Env:

@@ -11,15 +11,8 @@ import tarfile
 import pytest
 
 from makisu_tpu.snapshot import CopyOperation, MemFS, eval_symlinks
-from makisu_tpu.utils import mountinfo
 
 
-@pytest.fixture(autouse=True)
-def _no_mounts():
-    """Tmp roots must not inherit the host mount table's skip rules."""
-    mountinfo.set_mountpoints_for_testing(set())
-    yield
-    mountinfo.set_mountpoints_for_testing(None)
 
 
 def new_fs(root) -> MemFS:

@@ -2,15 +2,9 @@
 
 import pytest
 
-from makisu_tpu.utils import mountinfo
 from makisu_tpu.worker import WorkerClient, WorkerServer
 
 
-@pytest.fixture(autouse=True)
-def _no_mounts():
-    mountinfo.set_mountpoints_for_testing(set())
-    yield
-    mountinfo.set_mountpoints_for_testing(None)
 
 
 @pytest.fixture
