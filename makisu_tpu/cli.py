@@ -397,6 +397,11 @@ def main(argv: list[str] | None = None) -> int:
         profiler.enable()
     jax_trace = False
     if getattr(args, "jax_profile", ""):
+        # Importing ops FIRST re-asserts JAX_PLATFORMS from the env
+        # (sitecustomize preloads jax pinned to the axon TPU tunnel;
+        # start_trace would otherwise initialize that backend before
+        # the build's own platform selection and can hang on it).
+        from makisu_tpu import ops  # noqa: F401
         import jax
         jax.profiler.start_trace(args.jax_profile)
         jax_trace = True

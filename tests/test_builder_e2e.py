@@ -19,8 +19,6 @@ from makisu_tpu.dockerfile import parse_file
 from makisu_tpu.storage import ImageStore
 
 
-
-
 @pytest.fixture
 def env(tmp_path):
     """(root, context, store, make_ctx) fixture bundle."""

@@ -15,8 +15,6 @@ from makisu_tpu.dockerfile import parse_file
 from makisu_tpu.storage import ImageStore
 
 
-
-
 def build(tmp_path, tag, kv, chunk_root, store_name, payload: bytes):
     """One builder instance with its own layer store but shared KV and
     shared chunk store (simulating two machines + distributed planes)."""

@@ -11,8 +11,6 @@ import pytest
 from makisu_tpu import cli
 
 
-
-
 @pytest.fixture
 def context(tmp_path):
     ctx = tmp_path / "ctx"
@@ -206,3 +204,18 @@ def test_build_compression_levels(tmp_path, context, level):
                    "--dest", str(dest)])
     assert rc == 0
     assert dest.exists()
+
+
+def test_jax_profile_flag_writes_trace(tmp_path, context):
+    """--jax-profile must re-assert the JAX platform BEFORE starting the
+    trace (the host preloads jax pinned to a TPU tunnel; starting the
+    profiler first would initialize that backend and hang)."""
+    root = tmp_path / "root"
+    root.mkdir()
+    trace = tmp_path / "trace"
+    rc = cli.main(["--jax-profile", str(trace),
+                   "build", str(context), "-t", "prof/t:1",
+                   "--storage", str(tmp_path / "s"), "--root", str(root)])
+    assert rc == 0
+    files = [p for p in trace.rglob("*") if p.is_file()]
+    assert files  # xplane/trace artifacts written

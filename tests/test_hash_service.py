@@ -130,39 +130,34 @@ def test_full_build_with_shared_hasher(tmp_path, service):
     from makisu_tpu.docker.image import ImageName
     from makisu_tpu.dockerfile import parse_file
     from makisu_tpu.storage import ImageStore
-    from makisu_tpu.utils import mountinfo
     import json
 
-    mountinfo.set_mountpoints_for_testing(set())
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "data.bin").write_bytes(
+        np.random.default_rng(5).integers(
+            0, 256, size=100_000, dtype=np.uint8).tobytes())
+    root = tmp_path / "root"
+    root.mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    hasher = TPUHasher()
+    hasher.shared = True
+    import makisu_tpu.chunker.service as svc_mod
+    orig = svc_mod._global_service
+    svc_mod._global_service = service
     try:
-        ctx_dir = tmp_path / "ctx"
-        ctx_dir.mkdir()
-        (ctx_dir / "data.bin").write_bytes(
-            np.random.default_rng(5).integers(
-                0, 256, size=100_000, dtype=np.uint8).tobytes())
-        root = tmp_path / "root"
-        root.mkdir()
-        store = ImageStore(str(tmp_path / "store"))
-        hasher = TPUHasher()
-        hasher.shared = True
-        import makisu_tpu.chunker.service as svc_mod
-        orig = svc_mod._global_service
-        svc_mod._global_service = service
-        try:
-            ctx = BuildContext(str(root), str(ctx_dir), store,
-                               hasher=hasher, sync_wait=0.0)
-            kv = MemoryStore()
-            mgr = CacheManager(kv, store)
-            plan = BuildPlan(ctx, ImageName("", "svc/build", "1"), [], mgr,
-                             parse_file("FROM scratch\nCOPY data.bin /d\n"),
-                             allow_modify_fs=False, force_commit=True)
-            manifest = plan.execute()
-            mgr.wait_for_push()
-            entries = [json.loads(v) for v in kv._data.values()
-                       if v != "MAKISU_TPU_CACHE_EMPTY"]
-            assert any("chunks" in e for e in entries)
-            assert manifest.layers
-        finally:
-            svc_mod._global_service = orig
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=hasher, sync_wait=0.0)
+        kv = MemoryStore()
+        mgr = CacheManager(kv, store)
+        plan = BuildPlan(ctx, ImageName("", "svc/build", "1"), [], mgr,
+                         parse_file("FROM scratch\nCOPY data.bin /d\n"),
+                         allow_modify_fs=False, force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        entries = [json.loads(v) for v in kv._data.values()
+                   if v != "MAKISU_TPU_CACHE_EMPTY"]
+        assert any("chunks" in e for e in entries)
+        assert manifest.layers
     finally:
-        mountinfo.set_mountpoints_for_testing(None)
+        svc_mod._global_service = orig

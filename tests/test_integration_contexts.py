@@ -27,8 +27,6 @@ from makisu_tpu.storage import ImageStore
 from makisu_tpu.utils import mountinfo
 
 
-
-
 class Env:
     def __init__(self, tmp_path):
         self.tmp = tmp_path

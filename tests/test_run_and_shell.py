@@ -14,8 +14,6 @@ from makisu_tpu.dockerfile import parse_file
 from makisu_tpu.storage import ImageStore
 
 
-
-
 def test_exec_command_streams_and_succeeds(tmp_path):
     shell.exec_command(str(tmp_path), "", "sh", "-c", "echo ok > out.txt")
     assert (tmp_path / "out.txt").read_text() == "ok\n"

@@ -13,8 +13,6 @@ import pytest
 from makisu_tpu.snapshot import CopyOperation, MemFS, eval_symlinks
 
 
-
-
 def new_fs(root) -> MemFS:
     return MemFS(str(root), blacklist=[], sync_wait=0.0)
 

@@ -22,8 +22,6 @@ from hypothesis import strategies as st
 from makisu_tpu.snapshot import MemFS
 
 
-
-
 _NAMES = ["a", "b", "sub", "deep/x", "deep/y", "café"]
 
 # Monotone fake mtimes: scans compare headers at 1-second granularity
@@ -72,9 +70,11 @@ def _apply(root: str, op) -> None:
         elif kind == "chmod":
             if os.path.lexists(path) and not os.path.islink(path):
                 os.chmod(path, op[2])
-        if os.path.lexists(path) and not os.path.islink(path):
+        # Stamp the REAL target (writes may go through a symlink).
+        target = os.path.realpath(path)
+        if os.path.lexists(target) and not os.path.islink(target):
             stamp = next(_mtimes)
-            os.utime(path, (stamp, stamp))
+            os.utime(target, (stamp, stamp))
     except OSError:
         pass  # invalid combos (e.g. parent is a file) just no-op
 

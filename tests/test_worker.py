@@ -5,8 +5,6 @@ import pytest
 from makisu_tpu.worker import WorkerClient, WorkerServer
 
 
-
-
 @pytest.fixture
 def worker(tmp_path):
     server = WorkerServer(str(tmp_path / "worker.sock"))
