@@ -31,9 +31,10 @@ RUN make -C native && pip install --no-cache-dir .
 
 FROM python:3.12-slim
 
-# JAX CPU backend for the accelerator code paths; on TPU hosts the
-# libtpu plugin comes from the host image/driver instead.
-RUN pip install --no-cache-dir "jax[cpu]" numpy
+# JAX CPU backend for the accelerator code paths (on TPU hosts the
+# libtpu plugin comes from the host image/driver instead); pyyaml for
+# YAML --registry-config files.
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml
 
 COPY --from=builder /usr/local/lib/python3.12/site-packages \
     /usr/local/lib/python3.12/site-packages

@@ -139,12 +139,13 @@ class ChunkStore:
         try:
             self.registry.push_manifest(tag, manifest)
         except HTTPError as e:
-            # BLOB_UNKNOWN: chunks reused from earlier layers were never
-            # pushed to THIS repo. Upload them (HEAD-skips existing
-            # ones) and retry once. Anything else (auth, media-type or
-            # size rejection) cannot be fixed by pushing blobs —
-            # propagate instead of sweeping every chunk.
-            if e.status != 404 and b"BLOB_UNKNOWN" not in e.body:
+            # BLOB_UNKNOWN (in the error body): chunks reused from
+            # earlier layers were never pushed to THIS repo. Upload
+            # them (HEAD-skips existing ones) and retry once. Anything
+            # else — auth, media-type/size rejection, NAME_UNKNOWN —
+            # cannot be fixed by pushing blobs; propagate instead of
+            # sweeping up to PIN_SHARD_CHUNKS network round-trips.
+            if b"BLOB_UNKNOWN" not in e.body:
                 raise
             for _, _, hex_digest in shard:
                 self.push_remote(hex_digest)
@@ -293,9 +294,14 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                     except Exception as e:  # noqa: BLE001
                         log.warning("chunk pin for %s failed: %s",
                                     layer_hex, e)
+                import contextvars
                 import threading
-                t = threading.Thread(target=push_chunks, daemon=True,
-                                     name=f"chunkpush-{cache_id}")
+                # Carry the caller's context so worker-mode log sinks
+                # attribute pin/push failures to the right build.
+                t = threading.Thread(
+                    target=contextvars.copy_context().run,
+                    args=(push_chunks,), daemon=True,
+                    name=f"chunkpush-{cache_id}")
                 t.start()
                 with manager._lock:
                     manager._pushes.append(t)

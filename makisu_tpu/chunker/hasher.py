@@ -107,24 +107,27 @@ class LayerSink:
             self._worker = threading.Thread(target=run, daemon=True)
             self._worker.start()
 
+    def _put_checked(self, item) -> None:
+        """Bounded put that re-checks for a dead worker: if the
+        compressor thread died while the queue was full, a plain put()
+        would block forever and hang the build instead of surfacing
+        the error."""
+        import queue as queue_mod
+        while True:
+            try:
+                self._queue.put(item, timeout=1.0)
+                return
+            except queue_mod.Full:
+                if self._worker_error:
+                    raise RuntimeError("layer compression failed") \
+                        from self._worker_error[0]
+
     def write(self, data: bytes) -> int:
         if self._worker_error:
             raise RuntimeError("layer compression failed") \
                 from self._worker_error[0]
         if self._queue is not None:
-            # Bounded put that re-checks for a dead worker: if the
-            # compressor thread died while the queue was full, a plain
-            # put() would block forever and hang the build instead of
-            # surfacing the error.
-            import queue as queue_mod
-            while True:
-                try:
-                    self._queue.put(bytes(data), timeout=1.0)
-                    break
-                except queue_mod.Full:
-                    if self._worker_error:
-                        raise RuntimeError("layer compression failed") \
-                            from self._worker_error[0]
+            self._put_checked(bytes(data))
         self._tar_digest.update(data)
         if self._queue is None:
             self._gz.write(data)
@@ -148,17 +151,7 @@ class LayerSink:
             raise RuntimeError("layer sink already finished")
         self._closed = True
         if self._queue is not None:
-            # Same bounded put as write(): a worker that died with the
-            # queue full must surface its error, not hang the build.
-            import queue as queue_mod
-            while True:
-                try:
-                    self._queue.put(None, timeout=1.0)
-                    break
-                except queue_mod.Full:
-                    if self._worker_error:
-                        raise RuntimeError("layer compression failed") \
-                            from self._worker_error[0]
+            self._put_checked(None)
             self._worker.join()
             if self._worker_error:
                 raise RuntimeError("layer compression failed") \

@@ -90,6 +90,11 @@ class RegistryClient:
                          if sec.client_cert else None))
         self._token: str | None = None
         self._limiter = _RateLimiter(self.config.push_rate)
+        # Cross-origin blob redirects (S3/GCS presigned URLs) use a
+        # default public-CA transport: the registry's private CA bundle
+        # and mTLS client cert must not apply to the CDN. Tests inject
+        # their fixture here.
+        self.cdn_transport: Transport = Transport()
 
     # -- naming -----------------------------------------------------------
 
@@ -105,11 +110,14 @@ class RegistryClient:
         return f"{scheme}://{host}/v2/{self.repository}"
 
     def _absolute(self, location: str) -> str:
-        """Resolve a possibly-relative Location header against the
-        registry origin (the v2 spec allows both forms)."""
+        """Resolve a relative or scheme-relative Location header against
+        the registry origin (RFC 3986 allows all three forms)."""
         if location.startswith("http"):
             return location
         base = self._base().split("/v2/")[0]
+        if location.startswith("//"):
+            # Scheme-relative: different host, registry's scheme.
+            return base.split("//")[0] + location
         return base + location
 
     def _same_origin(self, url: str) -> bool:
@@ -321,9 +329,10 @@ class RegistryClient:
                     resp = self._send("GET", location, stream_to=tmp)
                 else:
                     # Cross-origin presigned URL (S3/GCS): forwarding
-                    # registry credentials would leak them.
+                    # registry credentials would leak them, and the
+                    # registry-pinned transport must not apply.
                     resp = send(
-                        self.transport, "GET", location, {},
+                        self.cdn_transport, "GET", location, {},
                         retries=self.config.retries,
                         timeout=self.config.timeout, stream_to=tmp)
             if resp.status == 200 and resp.body:

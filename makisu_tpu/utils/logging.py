@@ -19,14 +19,17 @@ _LOGGER_NAME = "makisu"
 # async cache pushes) carry the context along, so concurrent builds'
 # log streams never cross. A plain logging.Handler on the shared logger
 # could not do this — every handler sees every build's records.
-_build_sink: "contextvars.ContextVar[Callable | None]" = \
+_build_sink: "contextvars.ContextVar[tuple[Callable, int] | None]" = \
     contextvars.ContextVar("makisu_build_sink", default=None)
 
 
-def set_build_sink(sink: "Callable[[str, str, dict], None] | None"):
-    """Bind a per-context sink receiving (level, message, fields).
-    Returns a token for reset_build_sink."""
-    return _build_sink.set(sink)
+def set_build_sink(sink: "Callable[[str, str, dict], None] | None",
+                   level: str = "info"):
+    """Bind a per-context sink receiving (level, message, fields) for
+    records at or above ``level``. Returns a token for
+    reset_build_sink."""
+    threshold = getattr(logging, level.upper(), logging.INFO)
+    return _build_sink.set(None if sink is None else (sink, threshold))
 
 
 def reset_build_sink(token) -> None:
@@ -61,18 +64,32 @@ class _ConsoleFormatter(logging.Formatter):
         return msg
 
 
+_configure_lock = __import__("threading").Lock()
+_configured_as: tuple | None = None
+
+
 def configure(level: str = "info", fmt: str = "json",
               output: str = "stdout") -> None:
-    logger = logging.getLogger(_LOGGER_NAME)
-    logger.handlers.clear()
-    stream = sys.stderr if output == "stderr" else sys.stdout
-    handler = (logging.FileHandler(output) if output not in
-               ("stdout", "stderr") else logging.StreamHandler(stream))
-    handler.setFormatter(_JsonFormatter() if fmt == "json"
-                         else _ConsoleFormatter())
-    logger.addHandler(handler)
-    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
-    logger.propagate = False
+    """(Re)configure the shared logger. Serialized, and a no-op when the
+    settings are unchanged — concurrent worker builds each call this,
+    and a clear/add race would drop or duplicate records. With
+    DIFFERENT settings the last caller wins for the shared console
+    stream; per-build log levels apply to build sinks, not here."""
+    global _configured_as
+    with _configure_lock:
+        if _configured_as == (level, fmt, output):
+            return
+        logger = logging.getLogger(_LOGGER_NAME)
+        logger.handlers.clear()
+        stream = sys.stderr if output == "stderr" else sys.stdout
+        handler = (logging.FileHandler(output) if output not in
+                   ("stdout", "stderr") else logging.StreamHandler(stream))
+        handler.setFormatter(_JsonFormatter() if fmt == "json"
+                             else _ConsoleFormatter())
+        logger.addHandler(handler)
+        logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+        logger.propagate = False
+        _configured_as = (level, fmt, output)
 
 
 def get_logger() -> logging.Logger:
@@ -86,8 +103,11 @@ def _log(level: int, msg: str, *args: Any, **fields: Any) -> None:
     if args:
         msg = msg % args
     get_logger().log(level, msg, extra={"fields": fields} if fields else {})
-    sink = _build_sink.get()
-    if sink is not None:
+    bound = _build_sink.get()
+    if bound is not None:
+        sink, threshold = bound
+        if level < threshold:
+            return
         try:
             sink(logging.getLevelName(level).lower(), msg, fields)
         except Exception:  # noqa: BLE001 - a dead client must not kill logging

@@ -118,7 +118,15 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             except OSError:
                 pass  # client went away; keep building
 
-        token = log.set_build_sink(sink)
+        # The sink honors this build's own --log-level (the shared
+        # console logger's level is process-global and can't).
+        level = "info"
+        for i, arg in enumerate(argv):
+            if arg == "--log-level" and i + 1 < len(argv):
+                level = argv[i + 1]
+            elif arg.startswith("--log-level="):
+                level = arg.split("=", 1)[1]
+        token = log.set_build_sink(sink, level.replace("warn", "warning"))
         locks = self._shared_path_locks(argv)
         for lock in locks:
             lock.acquire()
@@ -138,15 +146,20 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         """Locks for this build's --root/--storage dirs (created on
         demand, acquired in sorted order so overlapping sets can't
         deadlock). Builds with disjoint paths share no locks and run
-        fully in parallel."""
+        fully in parallel. Both ``--flag PATH`` and ``--flag=PATH``
+        spellings resolve, and paths canonicalize through symlinks —
+        missing either would let two builds race on one filesystem."""
         paths = set()
         for flag in ("--root", "--storage"):
-            if flag in argv:
-                idx = argv.index(flag)
-                if idx + 1 < len(argv):
-                    paths.add(f"{flag}={os.path.abspath(argv[idx + 1])}")
-            else:
-                paths.add(f"{flag}=<default>")
+            value = None
+            for i, arg in enumerate(argv):
+                if arg == flag and i + 1 < len(argv):
+                    value = argv[i + 1]
+                elif arg.startswith(flag + "="):
+                    value = arg[len(flag) + 1:]
+            key = (os.path.realpath(value) if value is not None
+                   else "<default>")
+            paths.add(f"{flag}={key}")
         with self._path_locks_mu:
             return [self._path_locks.setdefault(p, threading.Lock())
                     for p in sorted(paths)]
