@@ -229,12 +229,13 @@ class _NativeTarWriter:
 
 class NativeLayerSink:
     """Layer sink backed by native/layersink.cpp: the whole per-byte
-    pipeline (tar framing, dual sha256, gzip) runs in C++. Digest-only —
-    the TPU hasher keeps the Python pipeline because chunk bytes must
-    ship to the device anyway."""
+    pipeline (tar framing, dual sha256, gzip) runs in C++. With a
+    ``session`` (TPU hasher) the uncompressed stream additionally taps
+    into the chunker via a native callback, so CDC fingerprinting rides
+    the same single pass."""
 
-    def __init__(self, out: BinaryIO, backend_id: str | None = None)\
-            -> None:
+    def __init__(self, out: BinaryIO, backend_id: str | None = None,
+                 session=None) -> None:
         from makisu_tpu import native
         self.backend_id = backend_id or tario.gzip_backend_id()
         parts = self.backend_id.split("-")
@@ -243,6 +244,9 @@ class NativeLayerSink:
         out.flush()  # nothing buffered may trail the native fd writes
         self._handle = native.LayerSinkHandle(
             out.fileno(), backend, level, block or native.DEFAULT_BLOCK)
+        self._session = session
+        if session is not None:
+            self._handle.set_tap(session.update)
 
     def open_tar(self) -> _NativeTarWriter:
         return _NativeTarWriter(self)
@@ -258,7 +262,11 @@ class NativeLayerSink:
             tar_digest=Digest.from_hex(tar_hex),
             gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, gz_size,
                                        Digest.from_hex(gz_hex)))
-        return LayerCommit(pair, [], gzip_backend_id=self.backend_id)
+        chunks = []
+        if self._session is not None:
+            chunks = [ChunkFingerprint(c.offset, c.length, c.hex)
+                      for c in self._session.finish()]
+        return LayerCommit(pair, chunks, gzip_backend_id=self.backend_id)
 
 
 class Hasher(Protocol):
@@ -278,6 +286,19 @@ def _native_sink_enabled() -> bool:
     return native.layersink_available()
 
 
+def _use_native(out: BinaryIO) -> bool:
+    """One decision point for native-vs-Python pipelines (the choice is
+    cache-identity-neutral but must be consistent across hashers):
+    native needs a real fd; in-memory outputs (tests) take Python."""
+    if not _native_sink_enabled():
+        return False
+    try:
+        out.fileno()
+    except (OSError, AttributeError, ValueError):
+        return False
+    return True
+
+
 class CPUHasher:
     """Parity with the reference: digests only, no chunking. Uses the
     native C++ pipeline when available (MAKISU_TPU_NATIVE_SINK=0 forces
@@ -287,13 +308,8 @@ class CPUHasher:
 
     def open_layer(self, out: BinaryIO,
                    backend_id: str | None = None) -> LayerSink:
-        if _native_sink_enabled():
-            try:
-                out.fileno()
-            except (OSError, AttributeError, ValueError):
-                pass  # in-memory outputs (tests) take the Python path
-            else:
-                return NativeLayerSink(out, backend_id=backend_id)
+        if _use_native(out):
+            return NativeLayerSink(out, backend_id=backend_id)
         return LayerSink(out, backend_id=backend_id)
 
 
@@ -338,9 +354,14 @@ class TPUHasher:
         if self.shared:
             from makisu_tpu.chunker.service import shared_service
             service = shared_service()
-        return _TPUSink(out, ChunkSession(
-            self.avg_bits, self.min_size, self.max_size, service=service),
-            backend_id=backend_id)
+        session = ChunkSession(self.avg_bits, self.min_size,
+                               self.max_size, service=service)
+        if _use_native(out):
+            # Native pipeline + chunker tap: one pass does tar framing,
+            # digests, gzip (C++) AND CDC intake (device).
+            return NativeLayerSink(out, backend_id=backend_id,
+                                   session=session)
+        return _TPUSink(out, session, backend_id=backend_id)
 
 
 def get_hasher(name: str) -> Hasher:

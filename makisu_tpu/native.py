@@ -36,6 +36,11 @@ _lsk_failed = False
 
 DEFAULT_BLOCK = 128 * 1024
 
+# Tap callback: (data_ptr, nbytes, user) — the uncompressed tar stream,
+# called synchronously from the native pipeline on the writer's thread.
+_TAP_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_uint8),
+                           ctypes.c_size_t, ctypes.c_void_p)
+
 
 def _ensure_built(lib_path: str) -> bool:
     """Run make (mtime-based, so stale .so files rebuild — their output
@@ -103,6 +108,9 @@ def _load_lsk() -> ctypes.CDLL | None:
             lib.lsk_write_file.argtypes = [ctypes.c_void_p,
                                            ctypes.c_char_p,
                                            ctypes.c_uint64]
+            lib.lsk_set_tap.restype = None
+            lib.lsk_set_tap.argtypes = [ctypes.c_void_p, _TAP_FN,
+                                        ctypes.c_void_p]
             lib.lsk_finish.restype = ctypes.c_int
             lib.lsk_finish.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
@@ -145,13 +153,41 @@ class LayerSinkHandle:
             raise RuntimeError("native layer sink already closed")
         return self._handle
 
+    def set_tap(self, fn) -> None:
+        """Stream every uncompressed tar byte to ``fn(bytes)`` as well
+        (the TPU chunker's intake). The CFUNCTYPE wrapper is pinned on
+        self so the callback outlives the ctypes call.
+
+        ctypes callbacks cannot propagate exceptions into C; a failure
+        is recorded and re-raised by the NEXT write/finish call, so a
+        dying chunker fails the build instead of silently producing
+        wrong (cache-identity-bearing) fingerprints."""
+        self._tap_error: list = []
+
+        def trampoline(ptr, n, _user):
+            if self._tap_error:
+                return  # already failed; drain quietly until re-raise
+            try:
+                fn(ctypes.string_at(ptr, n))
+            except BaseException as e:  # noqa: BLE001
+                self._tap_error.append(e)
+        self._tap_ref = _TAP_FN(trampoline)  # keep alive
+        self._lib.lsk_set_tap(self._live(), self._tap_ref, None)
+
+    def _check_tap(self) -> None:
+        err = getattr(self, "_tap_error", None)
+        if err:
+            raise RuntimeError("layer chunk tap failed") from err[0]
+
     def write(self, data: bytes) -> None:
         if self._lib.lsk_write(self._live(), data, len(data)) != 0:
             raise RuntimeError("native layer sink write failed")
+        self._check_tap()
 
     def write_file(self, path: str, size: int) -> None:
         rc = self._lib.lsk_write_file(
             self._live(), os.fsencode(path), size)
+        self._check_tap()
         if rc == -2:
             raise OSError(f"native layer sink could not read {path}")
         if rc == -3:
@@ -170,6 +206,7 @@ class LayerSinkHandle:
                                   ctypes.byref(tar_size))
         if rc != 0:
             raise RuntimeError("native layer sink finish failed")
+        self._check_tap()
         return (bytes(tar_sha).hex(), bytes(gz_sha).hex(),
                 gz_size.value, tar_size.value)
 

@@ -92,9 +92,12 @@ class RegistryClient:
         self._limiter = _RateLimiter(self.config.push_rate)
         # Cross-origin blob redirects (S3/GCS presigned URLs) use a
         # default public-CA transport: the registry's private CA bundle
-        # and mTLS client cert must not apply to the CDN. Tests inject
-        # their fixture here.
-        self.cdn_transport: Transport = Transport()
+        # and mTLS client cert must not apply to the CDN. Air-gapped
+        # registries whose redirect target shares the private CA opt
+        # back in via security.trust_redirects. Tests inject their
+        # fixture here.
+        self.cdn_transport: Transport = (
+            self.transport if sec.trust_redirects else Transport())
 
     # -- naming -----------------------------------------------------------
 

@@ -32,6 +32,12 @@ class SecurityConfig:
     basic_user: str = ""
     basic_password: str = ""
     cred_helper: str = ""  # docker-credential-<name> executable suffix
+    # Cross-origin blob redirects normally use a default public-CA
+    # transport (presigned S3/GCS URLs must not see the registry's
+    # private CA or mTLS identity). Air-gapped setups whose redirect
+    # target shares the registry's private CA set this to reuse the
+    # registry transport for redirects.
+    trust_redirects: bool = False
 
     @staticmethod
     def from_json(d: dict) -> "SecurityConfig":
@@ -46,6 +52,7 @@ class SecurityConfig:
             basic_user=basic.get("username", ""),
             basic_password=basic.get("password", ""),
             cred_helper=d.get("credsStore", ""),
+            trust_redirects=bool(d.get("trust_redirects", False)),
         )
 
 

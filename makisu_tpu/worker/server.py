@@ -77,6 +77,18 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client hung up; not our problem
 
 
+def _argv_flag_value(argv: list[str], flag: str) -> str | None:
+    """Last value of ``--flag VALUE`` or ``--flag=VALUE`` in argv (both
+    argparse spellings), or None."""
+    value = None
+    for i, arg in enumerate(argv):
+        if arg == flag and i + 1 < len(argv):
+            value = argv[i + 1]
+        elif arg.startswith(flag + "="):
+            value = arg[len(flag) + 1:]
+    return value
+
+
 class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     allow_reuse_address = True
     daemon_threads = True
@@ -120,12 +132,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
 
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
-        level = "info"
-        for i, arg in enumerate(argv):
-            if arg == "--log-level" and i + 1 < len(argv):
-                level = argv[i + 1]
-            elif arg.startswith("--log-level="):
-                level = arg.split("=", 1)[1]
+        level = _argv_flag_value(argv, "--log-level") or "info"
         token = log.set_build_sink(sink, level.replace("warn", "warning"))
         locks = self._shared_path_locks(argv)
         for lock in locks:
@@ -151,12 +158,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         missing either would let two builds race on one filesystem."""
         paths = set()
         for flag in ("--root", "--storage"):
-            value = None
-            for i, arg in enumerate(argv):
-                if arg == flag and i + 1 < len(argv):
-                    value = argv[i + 1]
-                elif arg.startswith(flag + "="):
-                    value = arg[len(flag) + 1:]
+            value = _argv_flag_value(argv, flag)
             key = (os.path.realpath(value) if value is not None
                    else "<default>")
             paths.add(f"{flag}={key}")

@@ -238,6 +238,12 @@ struct Sink {
   size_t block_size = 0;
   Digest256 tar_sha;  // uncompressed tar stream (diffID)
   Digest256 gz_sha;   // compressed blob (registry digest)
+  // Optional tap: every uncompressed tar byte is also handed to this
+  // callback (the TPU chunker consumes the stream for CDC while the
+  // native pipeline owns framing/hashing/compression). Invoked on the
+  // lsk_write/lsk_write_file caller's thread.
+  void (*tap)(const uint8_t*, size_t, void*) = nullptr;
+  void* tap_user = nullptr;
   uint64_t gz_size = 0;
   uint64_t tar_size = 0;
   uLong crc = 0;          // crc32 of the uncompressed stream (trailer)
@@ -392,6 +398,7 @@ struct Sink {
   // Every uncompressed tar byte flows through here exactly once.
   bool consume(const uint8_t* data, size_t n) {
     if (failed) return false;
+    if (tap) tap(data, n, tap_user);
     tar_sha.update(data, n);
     tar_size += n;
     size_t off = 0;  // crc32 takes uInt lengths; chunk for safety
@@ -452,6 +459,16 @@ void* lsk_new(int out_fd, int pgzip, int level, size_t block_size,
     }
   }
   return s;
+}
+
+// Install an uncompressed-stream tap (NULL clears). Must be set before
+// any write; the callback fires synchronously on the writer's thread.
+void lsk_set_tap(void* handle,
+                 void (*fn)(const uint8_t*, size_t, void*),
+                 void* user) {
+  auto* s = static_cast<Sink*>(handle);
+  s->tap = fn;
+  s->tap_user = user;
 }
 
 int lsk_write(void* handle, const uint8_t* data, size_t n) {
