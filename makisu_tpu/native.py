@@ -187,13 +187,15 @@ class LayerSinkHandle:
     def write_file(self, path: str, size: int) -> None:
         rc = self._lib.lsk_write_file(
             self._live(), os.fsencode(path), size)
-        self._check_tap()
         if rc == -2:
             raise OSError(f"native layer sink could not read {path}")
         if rc == -3:
             raise OSError(f"{path} shrank below its header size {size}")
         if rc != 0:
             raise RuntimeError("native layer sink write failed")
+        # After the rc checks: a tap failure must not mask the
+        # root-cause file error above.
+        self._check_tap()
 
     def finish(self) -> tuple[str, str, int, int]:
         """Returns (tar_sha_hex, gzip_sha_hex, gzip_size, tar_size)."""

@@ -94,10 +94,14 @@ class RegistryClient:
         # default public-CA transport: the registry's private CA bundle
         # and mTLS client cert must not apply to the CDN. Air-gapped
         # registries whose redirect target shares the private CA opt
-        # back in via security.trust_redirects. Tests inject their
-        # fixture here.
-        self.cdn_transport: Transport = (
-            self.transport if sec.trust_redirects else Transport())
+        # back in via security.trust_redirects. An explicitly injected
+        # transport (test fixtures, proxy/custom-TLS embedders) owns
+        # ALL traffic including redirects — never bypass it onto the
+        # real network.
+        if transport is not None or sec.trust_redirects:
+            self.cdn_transport: Transport = self.transport
+        else:
+            self.cdn_transport = Transport()
 
     # -- naming -----------------------------------------------------------
 

@@ -70,23 +70,24 @@ _configured_as: tuple | None = None
 
 def configure(level: str = "info", fmt: str = "json",
               output: str = "stdout") -> None:
-    """(Re)configure the shared logger. Serialized, and a no-op when the
-    settings are unchanged — concurrent worker builds each call this,
-    and a clear/add race would drop or duplicate records. With
-    DIFFERENT settings the last caller wins for the shared console
-    stream; per-build log levels apply to build sinks, not here."""
+    """(Re)configure the shared logger. Serialized, idempotent for
+    unchanged settings, and the handler list is swapped by a SINGLE
+    assignment — an emitter mid-callHandlers keeps iterating the old
+    list, so concurrent worker builds never drop records during a
+    reconfigure. With DIFFERENT settings the last caller wins for the
+    shared console stream; per-build log levels apply to build sinks,
+    not here."""
     global _configured_as
     with _configure_lock:
         if _configured_as == (level, fmt, output):
             return
         logger = logging.getLogger(_LOGGER_NAME)
-        logger.handlers.clear()
         stream = sys.stderr if output == "stderr" else sys.stdout
         handler = (logging.FileHandler(output) if output not in
                    ("stdout", "stderr") else logging.StreamHandler(stream))
         handler.setFormatter(_JsonFormatter() if fmt == "json"
                              else _ConsoleFormatter())
-        logger.addHandler(handler)
+        logger.handlers = [handler]  # atomic swap, no clear/add window
         logger.setLevel(getattr(logging, level.upper(), logging.INFO))
         logger.propagate = False
         _configured_as = (level, fmt, output)

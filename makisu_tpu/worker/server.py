@@ -53,18 +53,26 @@ class _Handler(BaseHTTPRequestHandler):
 
         # A build's stdout/stderr drain threads and async cache-push
         # threads all emit concurrently; chunk framing must be atomic or
-        # interleaved writes corrupt the HTTP stream.
+        # interleaved writes corrupt the HTTP stream. `finished` guards
+        # against stragglers (a cache/chunk push outliving the bounded
+        # wait_for_push join still carries this build's log context):
+        # once the terminal chunk is written, late frames are dropped
+        # instead of corrupting the ended HTTP body.
         emit_lock = threading.Lock()
+        finished = threading.Event()
 
         def emit(line: str) -> None:
             data = (line.rstrip("\n") + "\n").encode()
             frame = f"{len(data):x}\r\n".encode() + data + b"\r\n"
             with emit_lock:
+                if finished.is_set():
+                    return
                 self.wfile.write(frame)
 
         code = self.server.run_build(argv, emit)
         emit(json.dumps({"build_code": str(code)}))
         with emit_lock:
+            finished.set()
             self.wfile.write(b"0\r\n\r\n")
 
     def _respond(self, status: int, body: bytes) -> None:
@@ -77,16 +85,27 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client hung up; not our problem
 
 
-def _argv_flag_value(argv: list[str], flag: str) -> str | None:
-    """Last value of ``--flag VALUE`` or ``--flag=VALUE`` in argv (both
-    argparse spellings), or None."""
-    value = None
-    for i, arg in enumerate(argv):
-        if arg == flag and i + 1 < len(argv):
-            value = argv[i + 1]
-        elif arg.startswith(flag + "="):
-            value = arg[len(flag) + 1:]
-    return value
+def _effective_flags(argv: list[str]) -> dict:
+    """Resolve the flags the worker cares about through the REAL CLI
+    parser — hand-rolled argv scanning would miss argparse's equals
+    form, abbreviations ('--stor'), and defaults, any of which would
+    punch holes in path-lock serialization or per-build log levels."""
+    from makisu_tpu import cli
+    out = {"root": None, "storage": None, "log_level": "info"}
+    try:
+        args, _ = cli.make_parser().parse_known_args(argv)
+    except SystemExit:
+        return out  # malformed argv: cli.main will report the error
+    out["log_level"] = getattr(args, "log_level", "info")
+    root = getattr(args, "root", None)
+    if root is not None:
+        out["root"] = root
+    storage = getattr(args, "storage", None)
+    if storage is not None:
+        # "" means the computed default storage dir; resolve it so an
+        # explicit --storage of the same path shares the lock.
+        out["storage"] = cli._storage_dir(storage)
+    return out
 
 
 class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
@@ -132,7 +151,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
 
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
-        level = _argv_flag_value(argv, "--log-level") or "info"
+        level = _effective_flags(argv)["log_level"]
         token = log.set_build_sink(sink, level.replace("warn", "warning"))
         locks = self._shared_path_locks(argv)
         for lock in locks:
@@ -156,12 +175,13 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         fully in parallel. Both ``--flag PATH`` and ``--flag=PATH``
         spellings resolve, and paths canonicalize through symlinks —
         missing either would let two builds race on one filesystem."""
+        flags = _effective_flags(argv)
         paths = set()
-        for flag in ("--root", "--storage"):
-            value = _argv_flag_value(argv, flag)
+        for name in ("root", "storage"):
+            value = flags[name]
             key = (os.path.realpath(value) if value is not None
-                   else "<default>")
-            paths.add(f"{flag}={key}")
+                   else "<none>")
+            paths.add(f"--{name}={key}")
         with self._path_locks_mu:
             return [self._path_locks.setdefault(p, threading.Lock())
                     for p in sorted(paths)]

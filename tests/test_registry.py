@@ -236,9 +236,9 @@ def test_pull_redirect_body_never_stored(store, fixture):
     fixture.override("GET", r"cdn\.test/real-blob", Response(
         200, {}, layer_blob))
     c = client(store, fixture)
-    # Cross-origin follows use the CDN transport (default public-CA in
-    # production; the fixture here).
-    c.cdn_transport = fixture
+    # Injected transports own all traffic, including cross-origin
+    # redirect follows — no hand-wiring needed.
+    assert c.cdn_transport is fixture
     path = c.pull_layer(layer_digest)
     with open(path, "rb") as f:
         assert f.read() == layer_blob
