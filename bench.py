@@ -11,14 +11,17 @@ that with hashlib (OpenSSL) on this host and report the ratio.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N,
-   "backend": ..., ["error": ...]}
+   "backend": ..., "stage_reached": ..., ["error": ...]}
 
 Resilience contract: this script NEVER exits nonzero because a backend
 is flaky. The device measurement runs in a subprocess under a timeout —
 the TPU plugin here initializes through a tunnel that has been observed
-to hang indefinitely — and on failure/timeout the bench retries on the
-CPU backend and records what happened in the "error" field, so the
-driver always gets structured data.
+to hang indefinitely. The child emits a flushed JSON line after EVERY
+stage (start, jax import, backend init, tiny-shape number, big-shape
+number, Pallas A/B), so a hang or crash at any point still leaves the
+parent with (a) the deepest stage reached — a diagnosis, not a guess —
+and (b) any device throughput already measured. A timeout can therefore
+never erase an already-measured device number.
 """
 
 from __future__ import annotations
@@ -39,6 +42,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(_REPO, ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
+# Stage names in child execution order; the parent reports the deepest
+# one whose line it saw. Keep in sync with _child_main.
+_STAGES = ("start", "import", "backend", "tiny", "big", "ab")
+
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
     """Reference path: dual sequential SHA-256 over the stream."""
@@ -51,40 +58,40 @@ def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
     return nbytes / elapsed / 1e9
 
 
-def _device_throughput_gbps() -> tuple[float, str]:
+def _emit(stage: str, **fields) -> None:
+    """One flushed JSON line per stage; the parent merges them all."""
+    rec = {"stage": stage}
+    rec.update(fields)
+    print(json.dumps(rec), flush=True)
+
+
+def _measure_hasher(batch: int, block_bytes: int, lanes: int,
+                    lane_cap: int, iters: int) -> tuple[float, float]:
+    """Compile + run one SnapshotHasher config; returns (gbps, compile_s)."""
     import jax
 
     from makisu_tpu.models import SnapshotHasher
 
-    backend = jax.default_backend()
-    if backend == "cpu":
-        # Smoke shapes: validates the pipeline + output format on hosts
-        # without an accelerator; the recorded number is meaningless.
-        hasher = SnapshotHasher(batch=2, block_bytes=1024 * 1024,
-                                lanes=256, lane_cap=16 * 1024)
-    else:
-        # One step: gear-scan 24 x 4MiB stream blocks and hash 4096 full
-        # 16KiB chunk lanes — 96MiB of gear bytes + 64MiB of sha bytes.
-        hasher = SnapshotHasher(batch=24, block_bytes=4 * 1024 * 1024,
-                                lanes=4096, lane_cap=16 * 1024)
+    hasher = SnapshotHasher(batch=batch, block_bytes=block_bytes,
+                            lanes=lanes, lane_cap=lane_cap)
     rng = np.random.default_rng(1)
     blocks = jax.device_put(rng.integers(
-        0, 256, size=(hasher.batch, hasher.block_bytes), dtype=np.uint8))
-    lanes = jax.device_put(rng.integers(
-        0, 256, size=(hasher.lanes, hasher.lane_cap), dtype=np.uint8))
-    lengths = jax.device_put(np.full(
-        (hasher.lanes,), hasher.lane_cap - 64, dtype=np.int32))
+        0, 256, size=(batch, block_bytes), dtype=np.uint8))
+    lanes_arr = jax.device_put(rng.integers(
+        0, 256, size=(lanes, lane_cap), dtype=np.uint8))
+    lengths = jax.device_put(np.full((lanes,), lane_cap - 64,
+                                     dtype=np.int32))
     step = hasher.jit_forward()
-    jax.block_until_ready(step(blocks, lanes, lengths))  # compile
-    iters = 5 if backend != "cpu" else 2
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(blocks, lanes_arr, lengths))
+    compile_s = time.perf_counter() - t0
     start = time.perf_counter()
     for _ in range(iters):
-        out = step(blocks, lanes, lengths)
+        out = step(blocks, lanes_arr, lengths)
     jax.block_until_ready(out)
     elapsed = time.perf_counter() - start
-    total_bytes = iters * (hasher.batch * hasher.block_bytes
-                           + hasher.lanes * hasher.lane_cap)
-    return total_bytes / elapsed / 1e9, backend
+    total = iters * (batch * block_bytes + lanes * lane_cap)
+    return total / elapsed / 1e9, compile_s
 
 
 def _gear_ab_gbps() -> dict:
@@ -120,28 +127,69 @@ def _gear_ab_gbps() -> dict:
 
 
 def _child_main() -> int:
-    """Subprocess entry: measure on whatever backend JAX initializes.
+    """Subprocess entry: staged measurement on whatever backend JAX
+    initializes. Every stage line is flushed BEFORE the next stage
+    begins, so a hang/crash anywhere still leaves the parent with the
+    deepest completed stage and any numbers measured so far."""
+    _emit("start",
+          jax_platforms_env=os.environ.get("JAX_PLATFORMS", ""),
+          pid=os.getpid())
 
-    The main pipeline number prints FIRST (flushed) so that if the
-    experimental Pallas kernel crashes the process on real hardware,
-    the parent still reads the XLA result from the earlier line."""
-    value, backend = _device_throughput_gbps()
-    record = {"gbps": value, "backend": backend}
-    print(json.dumps(record), flush=True)
+    t0 = time.perf_counter()
+    import jax
+    # sitecustomize preloads jax before this process's env overrides can
+    # take effect, so re-assert the platform choice from the env (same
+    # dance as makisu_tpu/ops/__init__.py) — otherwise the CPU-fallback
+    # child would still try the hanging device tunnel.
+    if "JAX_PLATFORMS" in os.environ:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _emit("import", import_secs=round(time.perf_counter() - t0, 2))
+
+    t0 = time.perf_counter()
+    devices = jax.devices()           # forces backend client init
+    backend = jax.default_backend()
+    _emit("backend", backend=backend, devices=len(devices),
+          device_kind=getattr(devices[0], "device_kind", "?"),
+          init_secs=round(time.perf_counter() - t0, 2))
+
+    # Tiny shapes first: compiles in seconds even cold, so any working
+    # backend yields a device datapoint well inside the budget.
+    tiny_gbps, tiny_compile = _measure_hasher(
+        batch=2, block_bytes=1024 * 1024, lanes=256, lane_cap=16 * 1024,
+        iters=3)
+    _emit("tiny", backend=backend, tiny_gbps=round(tiny_gbps, 3),
+          tiny_compile_secs=round(tiny_compile, 1))
+
+    if backend == "cpu":
+        # No accelerator: the tiny smoke measurement above already
+        # validated the pipeline + output format on these exact shapes;
+        # re-measuring would just pay a second compile. The recorded
+        # number is meaningless on CPU either way.
+        gbps, compile_s = tiny_gbps, tiny_compile
+    else:
+        # One step: gear-scan 24 x 4MiB stream blocks and hash 4096 full
+        # 16KiB chunk lanes — 96MiB of gear bytes + 64MiB of sha bytes.
+        gbps, compile_s = _measure_hasher(
+            batch=24, block_bytes=4 * 1024 * 1024, lanes=4096,
+            lane_cap=16 * 1024, iters=5)
+    _emit("big", backend=backend, gbps=round(gbps, 3),
+          compile_secs=round(compile_s, 1))
+
     if backend != "cpu":
         try:
-            record.update(_gear_ab_gbps())
+            _emit("ab", **_gear_ab_gbps())
         except Exception as e:  # noqa: BLE001 - A/B is best-effort
-            record["pallas_error"] = str(e)[:300]
-        print(json.dumps(record), flush=True)
+            _emit("ab", pallas_error=str(e)[:300])
     return 0
 
 
 def _run_child(env_overrides: dict[str, str],
-               timeout: float) -> tuple[dict | None, str]:
-    """Run the device measurement in a subprocess. Returns (result json,
-    error string). The subprocess boundary is what makes a hung backend
-    init (tunnel never answers) recoverable: we kill and fall back."""
+               timeout: float) -> tuple[dict, str]:
+    """Run the staged device measurement in a subprocess. Returns
+    (merged stage fields incl. "stage_reached", error string). The
+    subprocess boundary is what makes a hung backend init (tunnel never
+    answers) recoverable: we kill and keep every stage line that made
+    it out."""
     env = dict(os.environ)
     env.update(env_overrides)
     stdout, stderr, failure = "", "", ""
@@ -157,20 +205,30 @@ def _run_child(env_overrides: dict[str, str],
     except subprocess.TimeoutExpired as e:
         stdout = (e.stdout.decode(errors="replace")
                   if isinstance(e.stdout, bytes) else e.stdout) or ""
-        failure = f"timeout after {timeout:.0f}s (backend init hang?)"
-    # Scan stdout even after a crash/timeout: the child flushes its XLA
-    # result line BEFORE attempting the experimental Pallas kernel, so a
-    # kernel segfault must not cost us the measured number.
-    for line in reversed(stdout.strip().splitlines()):
+        failure = f"timeout after {timeout:.0f}s"
+    merged: dict = {}
+    deepest = -1
+    for line in stdout.strip().splitlines():
         try:
             parsed = json.loads(line)
         except ValueError:
             continue
-        if isinstance(parsed, dict) and "gbps" in parsed:
-            if failure:
-                parsed.setdefault("pallas_error", failure)
-            return parsed, ""
-    return None, failure or "no JSON result line in child output"
+        if not isinstance(parsed, dict) or "stage" not in parsed:
+            continue
+        stage = parsed.pop("stage")
+        merged.update(parsed)
+        if stage in _STAGES:
+            deepest = max(deepest, _STAGES.index(stage))
+    if deepest >= 0:
+        merged["stage_reached"] = _STAGES[deepest]
+        if failure:
+            nxt = (_STAGES[deepest + 1]
+                   if deepest + 1 < len(_STAGES) else "?")
+            failure += (f" (last stage completed: {_STAGES[deepest]};"
+                        f" died in: {nxt})")
+    elif failure:
+        failure += " (no stage line emitted — child never started?)"
+    return merged, failure
 
 
 def main() -> int:
@@ -180,12 +238,19 @@ def main() -> int:
     cpu_timeout = float(os.environ.get("MAKISU_BENCH_CPU_TIMEOUT", "900"))
 
     result, err = _run_child({}, tpu_timeout)
-    if result is None:
+    if err:
         errors.append(f"device backend: {err}")
+    usable = "gbps" in result or "tiny_gbps" in result
+    if not usable:
+        device_diag = result  # keep the stage diagnosis from the attempt
         result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
-        if result is None:
+        if err:
             errors.append(f"cpu fallback: {err}")
-    elif (result.get("backend") != "cpu"
+        # Preserve what the device attempt DID reveal (e.g. its
+        # stage_reached / init timing) under a distinct key.
+        if device_diag:
+            result["device_attempt"] = device_diag
+    elif (result.get("backend") != "cpu" and "gbps" in result
           and os.environ.get("MAKISU_BENCH_SWEEP", "1") == "1"):
         # On a real device, also sweep the SHA round-unroll knob (read
         # at module import, hence one child per setting; each is a
@@ -199,8 +264,10 @@ def main() -> int:
         for unroll in ("8", "16"):
             alt, alt_err = _run_child(
                 {"MAKISU_TPU_SHA_UNROLL": unroll}, sweep_timeout)
-            if alt is None:
-                sweep[unroll] = f"error: {alt_err[:120]}"
+            if "gbps" not in alt:
+                sweep[unroll] = (
+                    f"error: stage={alt.get('stage_reached', 'none')}"
+                    f" ({alt_err[:120]})")
             elif alt.get("backend") != result.get("backend"):
                 # Fell back to another backend (flaky tunnel): the
                 # number is not comparable — record that, not it.
@@ -214,17 +281,30 @@ def main() -> int:
         if best is not None:
             result["best_sha_unroll"] = int(best)
 
+    # Headline value: the big-shape number if it was measured, else the
+    # tiny-shape device number (better a small-shape device datapoint
+    # than nothing — flagged via value_source).
+    if "gbps" in result:
+        value, source = result["gbps"], "big"
+    elif "tiny_gbps" in result:
+        value, source = result["tiny_gbps"], "tiny"
+    else:
+        value, source = 0.0, "none"
     record: dict = {
         "metric": "snapshot-hash throughput (gear CDC scan + lane SHA-256)",
-        "value": round(result["gbps"], 3) if result else 0.0,
+        "value": round(value, 3),
         "unit": "GB/s",
-        "vs_baseline": (round(result["gbps"] / baseline, 3)
-                        if result else 0.0),
-        "backend": result["backend"] if result else "none",
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+        "backend": result.get("backend", "none"),
+        "stage_reached": result.get("stage_reached", "none"),
     }
-    for extra in ("gear_xla_gbps", "gear_pallas_gbps", "pallas_error",
-                  "sha_unroll_sweep", "best_sha_unroll"):
-        if result and extra in result:
+    if source != "big":
+        record["value_source"] = source
+    for extra in ("tiny_gbps", "init_secs", "compile_secs",
+                  "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
+                  "pallas_error", "sha_unroll_sweep", "best_sha_unroll",
+                  "device_attempt", "jax_platforms_env", "device_kind"):
+        if extra in result:
             record[extra] = result[extra]
     if errors:
         record["error"] = "; ".join(errors)

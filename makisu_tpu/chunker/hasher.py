@@ -286,11 +286,19 @@ def _native_sink_enabled() -> bool:
     return native.layersink_available()
 
 
-def _use_native(out: BinaryIO) -> bool:
+def _use_native(out: BinaryIO, backend_id: str | None = None) -> bool:
     """One decision point for native-vs-Python pipelines (the choice is
     cache-identity-neutral but must be consistent across hashers):
-    native needs a real fd; in-memory outputs (tests) take Python."""
+    native needs a real fd; in-memory outputs (tests) take Python.
+
+    zlib level 0 is excluded: stored-block framing depends on write
+    granularity, and the C++ pipeline feeds deflate at a different
+    granularity than the (pinned, see tario._FixedGranularityWriter)
+    Python path — choosing native there would split cache identity by
+    host capability."""
     if not _native_sink_enabled():
+        return False
+    if (backend_id or tario.gzip_backend_id()) == "zlib-0":
         return False
     try:
         out.fileno()
@@ -308,7 +316,7 @@ class CPUHasher:
 
     def open_layer(self, out: BinaryIO,
                    backend_id: str | None = None) -> LayerSink:
-        if _use_native(out):
+        if _use_native(out, backend_id):
             return NativeLayerSink(out, backend_id=backend_id)
         return LayerSink(out, backend_id=backend_id)
 
@@ -356,7 +364,7 @@ class TPUHasher:
             service = shared_service()
         session = ChunkSession(self.avg_bits, self.min_size,
                                self.max_size, service=service)
-        if _use_native(out):
+        if _use_native(out, backend_id):
             # Native pipeline + chunker tap: one pass does tar framing,
             # digests, gzip (C++) AND CDC intake (device).
             return NativeLayerSink(out, backend_id=backend_id,

@@ -87,6 +87,48 @@ def compression_level() -> int:
     return _compression_level
 
 
+class _FixedGranularityWriter:
+    """Re-buffers writes into fixed-size blocks before the compressor.
+
+    zlib level 0 emits stored blocks whose framing depends on the SIZE
+    of each compress() call (measured: 64KiB vs 1MiB writes yield
+    different bytes), so without this wrapper the gzip digest of a
+    level-0 blob would depend on who wrote it (tarfile's ~16KiB writes
+    vs reconstitution's single whole-layer write) — splitting cache
+    identity. Feeding the compressor in exactly ``granularity`` chunks
+    makes the output a pure function of content again.
+    """
+
+    GRANULARITY = 64 * 1024
+
+    def __init__(self, gz) -> None:
+        self._gz = gz
+        self._buf = bytearray()
+
+    def write(self, data: bytes) -> int:
+        self._buf += data
+        g = self.GRANULARITY
+        while len(self._buf) >= g:
+            self._gz.write(bytes(self._buf[:g]))
+            del self._buf[:g]
+        return len(data)
+
+    def close(self) -> None:
+        if self._buf:
+            self._gz.write(bytes(self._buf))
+            self._buf.clear()
+        self._gz.close()
+
+    def flush(self) -> None:  # pragma: no cover - parity shim
+        pass
+
+    def __enter__(self) -> "_FixedGranularityWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def gzip_writer(fileobj: BinaryIO, level: int | None = None,
                 backend_id: str | None = None):
     """Deterministic gzip writer. ``backend_id`` (from a cache entry)
@@ -103,8 +145,12 @@ def gzip_writer(fileobj: BinaryIO, level: int | None = None,
     if backend == "pgzip":
         from makisu_tpu.native import PgzipWriter
         return PgzipWriter(fileobj, level=level, block_size=block)
-    return gzip.GzipFile(fileobj=fileobj, mode="wb", compresslevel=level,
-                         mtime=0, filename="")
+    gz = gzip.GzipFile(fileobj=fileobj, mode="wb", compresslevel=level,
+                       mtime=0, filename="")
+    if level == 0:
+        # Stored-block framing is write-granularity-dependent; pin it.
+        return _FixedGranularityWriter(gz)
+    return gz
 
 
 def gzip_reader(fileobj: BinaryIO) -> gzip.GzipFile:

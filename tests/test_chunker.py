@@ -233,3 +233,52 @@ def test_threaded_sink_matches_inline():
         commit = sink.finish()
         results.append((out.getvalue(), commit.digest_pair))
     assert results[0] == results[1]
+
+
+def test_zlib0_output_is_write_granularity_independent():
+    """Level-0 gzip bytes must be a pure function of content: the fixed
+    granularity rebuffer in tario.gzip_writer pins stored-block framing
+    regardless of how callers chunk their writes (tarfile ~16KiB vs
+    reconstitution's single whole-layer write)."""
+    import io
+
+    from makisu_tpu import tario
+    payload = rand_bytes(1_300_000, 14)
+    outputs = []
+    for chunk in (512, 16_384, 70_000, len(payload)):
+        out = io.BytesIO()
+        gz = tario.gzip_writer(out, backend_id="zlib-0")
+        for i in range(0, len(payload), chunk):
+            gz.write(payload[i:i + chunk])
+        gz.close()
+        outputs.append(out.getvalue())
+    assert all(o == outputs[0] for o in outputs[1:])
+    import gzip as gzip_mod
+    assert gzip_mod.decompress(outputs[0]) == payload
+
+
+def test_zlib0_layer_sink_and_reconstitution(tmp_path):
+    """--compression no (zlib-0) round-trips through chunk
+    reconstitution byte-identically, same contract as every other
+    level."""
+    import gzip as gzip_mod
+    import io
+
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.docker.image import Digest
+    payload = rand_bytes(300_000, 15)
+    out = io.BytesIO()
+    sink = TPUHasher().open_layer(out, backend_id="zlib-0")
+    sink.write(payload)
+    commit = sink.finish()
+    blob = out.getvalue()
+    assert gzip_mod.decompress(blob) == payload
+    assert commit.digest_pair.gzip_descriptor.digest == Digest.of_bytes(blob)
+    store = ChunkStore(str(tmp_path / "chunks"))
+    for c in commit.chunks:
+        store.put(c.hex_digest, payload[c.offset:c.offset + c.length])
+    rebuilt = store.reconstitute(
+        commit.digest_pair,
+        [(c.offset, c.length, c.hex_digest) for c in commit.chunks],
+        gz_backend="zlib-0")
+    assert rebuilt == blob

@@ -197,3 +197,19 @@ def test_native_tap_errors_fail_the_build(tmp_path):
         sink._handle.set_tap(sink._session.update)
         with pytest.raises(RuntimeError, match="chunk tap failed"):
             sink.write(b"x" * 100)
+
+
+def test_zlib0_never_chooses_native(tmp_path):
+    """zlib level 0 stored-block framing is write-granularity-dependent,
+    and the C++ pipeline writes at a different granularity than the
+    pinned Python path — the sink selector must refuse native there or
+    cache identity splits by host capability (advisor round-2 medium)."""
+    from makisu_tpu.chunker.hasher import CPUHasher, TPUHasher, _use_native
+    with open(tmp_path / "out.tar.gz", "wb") as f:
+        assert _use_native(f, "zlib-6")  # control: fd + native available
+        assert not _use_native(f, "zlib-0")
+        assert isinstance(CPUHasher().open_layer(f, backend_id="zlib-0"),
+                          LayerSink)
+        sink = TPUHasher().open_layer(f, backend_id="zlib-0")
+        assert isinstance(sink, LayerSink)
+        assert not isinstance(sink, NativeLayerSink)
