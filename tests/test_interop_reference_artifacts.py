@@ -1,0 +1,342 @@
+"""Wire-format interop against the reference's canned REAL image
+artifacts.
+
+Every other registry/image test in this repo consumes artifacts the repo
+itself produced — a self-consistent digest or manifest-field bug would be
+invisible. These tests replay the exact bytes the reference validates its
+pull path with (real docker-produced manifest/config/layer captured from
+a registry: /root/reference/testdata/files/{alpine,alpine_dup,busybox},
+served by lib/registry/pull_fixture.go:23-138), read-only, through this
+framework's fixture registry and snapshot engine.
+
+Artifact facts (verified here, not assumed):
+- alpine/test_distribution_manifest: schema2, pretty-printed (3-space
+  indent — exercises non-compact JSON), config digest a052f56e... ==
+  sha256(test_image_config), layer digest 393ccd5c... ==
+  sha256(test_layer.tar). The declared SIZES are stale (config says
+  2940, file is 1346; layer says 1902063, file is 675797) — real
+  registries don't enforce them and neither do we; digests rule.
+- test_layer.tar is despite its name a GZIPPED tar (1f 8b magic) — the
+  actual registry blob format.
+- The config's rootfs.diff_ids[0] equals the COMPRESSED blob digest
+  (synthetic quirk of the reference's canned artifact; a real image's
+  diff_id would be the uncompressed tar's digest) — so we assert
+  parse-and-match, not diff_id == sha256(gunzip(blob)).
+- alpine_dup's manifest lists the same layer digest twice (dedup test).
+- busybox/ is a legacy docker-save layout (manifest.json + v1-style
+  config json + <id>/layer.tar).
+"""
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+
+import pytest
+
+from makisu_tpu.docker.image import (
+    Digest,
+    DistributionManifest,
+    ImageConfig,
+    ImageName,
+)
+from makisu_tpu.registry import (
+    RegistryClient,
+    RegistryConfig,
+    RegistryFixture,
+)
+from makisu_tpu.storage import ImageStore
+
+_FILES = "/root/reference/testdata/files"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_FILES),
+    reason="reference canned artifacts not present")
+
+
+def _read(rel: str) -> bytes:
+    with open(os.path.join(_FILES, rel), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture
+def alpine():
+    manifest = _read("alpine/test_distribution_manifest")
+    config = _read("alpine/test_image_config")
+    layer = _read("alpine/test_layer.tar")
+    return manifest, config, layer
+
+
+def _serve_verbatim(fixture: RegistryFixture, repo: str, tag: str,
+                    manifest_bytes: bytes, blobs: dict[str, bytes]) -> None:
+    """serve_image() re-serializes; interop needs the WIRE bytes."""
+    fixture.manifests[f"{repo}:{tag}"] = manifest_bytes
+    for blob in blobs.values():
+        fixture.blobs[hashlib.sha256(blob).hexdigest()] = blob
+
+
+def _client(store, fixture, repo="library/alpine"):
+    return RegistryClient(store, "registry.test", repo,
+                          config=RegistryConfig(), transport=fixture)
+
+
+def test_alpine_artifact_digests_match_manifest(alpine):
+    """The canned artifacts really are digest-consistent (the property
+    every other assertion in this file rests on)."""
+    manifest_bytes, config, layer = alpine
+    manifest = DistributionManifest.from_bytes(manifest_bytes)
+    assert manifest.schema_version == 2
+    assert manifest.config.digest.hex() \
+        == hashlib.sha256(config).hexdigest()
+    assert [d.hex() for d in manifest.layer_digests()] \
+        == [hashlib.sha256(layer).hexdigest()]
+    assert layer[:2] == b"\x1f\x8b"  # registry blob format: gzip
+
+
+def test_alpine_pull_real_manifest_config_layer(tmp_path, alpine):
+    manifest_bytes, config, layer = alpine
+    fixture = RegistryFixture()
+    _serve_verbatim(fixture, "library/alpine", "latest", manifest_bytes,
+                    {"c": config, "l": layer})
+    store = ImageStore(str(tmp_path / "store"))
+    c = _client(store, fixture)
+    name = ImageName("registry.test", "library/alpine", "latest")
+    pulled = c.pull(name)
+    # Every blob landed in the CAS under its verified digest.
+    for desc in [pulled.config] + list(pulled.layers):
+        assert store.layers.exists(desc.digest.hex())
+        with open(store.layers.path(desc.digest.hex()), "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == desc.digest.hex()
+    # The stored layer blob is byte-identical to the wire artifact.
+    with open(store.layers.path(pulled.layers[0].digest.hex()), "rb") as f:
+        assert f.read() == layer
+    assert store.manifests.exists(name)
+
+
+def test_alpine_pull_by_digest_verifies_wire_bytes(tmp_path, alpine):
+    """Pull-by-digest must hash the exact (pretty-printed) wire bytes,
+    not a re-serialization."""
+    manifest_bytes, config, layer = alpine
+    wire_digest = "sha256:" + hashlib.sha256(manifest_bytes).hexdigest()
+    fixture = RegistryFixture()
+    _serve_verbatim(fixture, "library/alpine", wire_digest, manifest_bytes,
+                    {"c": config, "l": layer})
+    store = ImageStore(str(tmp_path / "store"))
+    pulled = _client(store, fixture).pull_manifest(wire_digest)
+    assert pulled.config.digest.hex() == hashlib.sha256(config).hexdigest()
+    # And a tampered manifest under the same digest is refused.
+    fixture.manifests["library/alpine:" + wire_digest] = \
+        manifest_bytes + b"\n"
+    with pytest.raises(ValueError, match="digest mismatch"):
+        _client(store, fixture).pull_manifest(wire_digest)
+
+
+def test_alpine_real_docker_config_parses(alpine):
+    _, config_bytes, layer = alpine
+    cfg = ImageConfig.from_bytes(config_bytes)
+    assert cfg.architecture == "amd64"
+    assert cfg.os == "linux"
+    assert cfg.docker_version == "17.03.1-ce"
+    assert cfg.config.cmd == ["sh"]
+    assert any(e.startswith("PATH=") for e in cfg.config.env)
+    # Two history entries, the CMD one an empty layer — the invariant
+    # stage building relies on (len(non-empty history) == len(layers)).
+    assert len(cfg.history) == 2
+    assert cfg.history[1].empty_layer is True
+    non_empty = [h for h in cfg.history if not h.empty_layer]
+    assert len(non_empty) == len(cfg.rootfs.diff_ids) == 1
+    # Canned-artifact quirk documented in the module docstring:
+    assert cfg.rootfs.diff_ids[0] \
+        == "sha256:" + hashlib.sha256(layer).hexdigest()
+
+
+def test_alpine_config_reserialization_roundtrip(alpine):
+    """Parse → serialize → parse preserves every field we model (the
+    bytes differ — key order/whitespace — but the content must not)."""
+    _, config_bytes, _ = alpine
+    cfg = ImageConfig.from_bytes(config_bytes)
+    again = ImageConfig.from_bytes(cfg.to_bytes())
+    assert again.to_json() == cfg.to_json()
+    assert again.config.env == cfg.config.env
+    assert again.rootfs.diff_ids == cfg.rootfs.diff_ids
+    assert [h.to_json() for h in again.history] \
+        == [h.to_json() for h in cfg.history]
+
+
+def test_alpine_dup_manifest_dedups_layer_fetch(tmp_path, alpine):
+    """The reference's duplicate-layers manifest (same digest listed
+    twice): pull succeeds and fetches the blob once."""
+    _, config, layer = alpine
+    dup_manifest = _read("alpine_dup/test_distribution_manifest")
+    parsed = DistributionManifest.from_bytes(dup_manifest)
+    assert len(parsed.layers) == 2
+    assert parsed.layers[0].digest == parsed.layers[1].digest
+    fixture = RegistryFixture()
+    _serve_verbatim(fixture, "library/alpine", "latest", dup_manifest,
+                    {"c": config, "l": layer})
+    store = ImageStore(str(tmp_path / "store"))
+    pulled = _client(store, fixture).pull(
+        ImageName("registry.test", "library/alpine", "latest"))
+    assert len(pulled.layers) == 2
+    layer_hex = pulled.layers[0].digest.hex()
+    gets = [u for m, u in fixture.requests
+            if m == "GET" and u.endswith("blobs/sha256:" + layer_hex)]
+    assert len(gets) == 1
+    assert store.layers.exists(layer_hex)
+
+
+def test_alpine_layer_untars_through_memfs(tmp_path, alpine):
+    """The real busybox-style rootfs (390 entries: dirs, symlink farms,
+    hardlinks, setuid bits) merges into MemFS and materializes on disk."""
+    from makisu_tpu.snapshot.memfs import MemFS
+    _, _, layer_blob = alpine
+    root = tmp_path / "root"
+    root.mkdir()
+    fs = MemFS(str(root), blacklist=[], sync_wait=0.0)
+    with gzip.GzipFile(fileobj=io.BytesIO(layer_blob)) as gz:
+        with tarfile.open(fileobj=gz, mode="r|") as tf:
+            merged = fs.update_from_tar(tf, untar=True)
+    # The alpine rootfs landed: shell, hardlink farm, passwd. In this
+    # docker-produced tar /bin is a farm of HARDLINKS to "bin/[" (the
+    # busybox binary stored once) — the second-pass hardlink handling
+    # in update_from_tar is what makes this work at all.
+    assert (root / "bin" / "busybox").exists()
+    assert (root / "etc" / "passwd").exists()
+    sh_stat = os.lstat(root / "bin" / "sh")
+    assert sh_stat.st_nlink > 100  # the whole farm shares one inode
+    assert sh_stat.st_ino == os.lstat(root / "bin" / "[").st_ino
+    # Hardlink/symlink/file counts in the merged layer match the tar.
+    with gzip.GzipFile(fileobj=io.BytesIO(layer_blob)) as gz:
+        with tarfile.open(fileobj=gz, mode="r|") as tf:
+            members = [m for m in tf
+                       if not (m.ischr() or m.isblk() or m.isfifo())]
+            want_links = sum(1 for m in members if m.issym() or m.islnk())
+    have_links = sum(
+        1 for e in merged.entries.values()
+        if e.hdr.issym() or e.hdr.islnk())
+    assert have_links == want_links
+    assert len(merged.entries) == len(members)
+
+
+def test_alpine_layer_roundtrips_through_commit_path(tmp_path, alpine):
+    """Untar the real rootfs, re-commit it through the layer sink, untar
+    THAT, and compare the trees — the full snapshot write path driven by
+    real-world content (multi-target symlinks, hardlinked busybox)."""
+    from makisu_tpu.chunker.hasher import CPUHasher
+    from makisu_tpu.snapshot.memfs import MemFS
+    _, _, layer_blob = alpine
+    root_a = tmp_path / "a"
+    root_a.mkdir()
+    fs = MemFS(str(root_a), blacklist=[], sync_wait=0.0)
+    with gzip.GzipFile(fileobj=io.BytesIO(layer_blob)) as gz:
+        with tarfile.open(fileobj=gz, mode="r|") as tf:
+            merged = fs.update_from_tar(tf, untar=True)
+
+    out = io.BytesIO()
+    sink = CPUHasher().open_layer(out, backend_id="zlib-6")
+    with sink.open_tar() as tw:
+        for path in sorted(merged.entries):
+            merged.entries[path].commit(tw)
+    commit = sink.finish()
+    blob = out.getvalue()
+    assert commit.digest_pair.gzip_descriptor.digest == \
+        Digest.of_bytes(blob)
+
+    root_b = tmp_path / "b"
+    root_b.mkdir()
+    fs_b = MemFS(str(root_b), blacklist=[], sync_wait=0.0)
+    with gzip.GzipFile(fileobj=io.BytesIO(blob)) as gz:
+        with tarfile.open(fileobj=gz, mode="r|") as tf:
+            again = fs_b.update_from_tar(tf, untar=True)
+    assert set(again.entries) == set(merged.entries)
+    import stat as stat_mod
+    for path, entry in merged.entries.items():
+        other = again.entries[path].hdr
+        hdr = entry.hdr
+        # docker's 2017 tars store the FULL st_mode (type bits included,
+        # e.g. 0o40755 for dirs); headers scanned back from disk store
+        # S_IMODE only — compare permission bits, which is what lands
+        # on the filesystem either way.
+        assert (hdr.type, stat_mod.S_IMODE(hdr.mode), hdr.uid, hdr.gid,
+                hdr.size, hdr.linkname) \
+            == (other.type, stat_mod.S_IMODE(other.mode), other.uid,
+                other.gid, other.size, other.linkname), path
+        if hdr.isreg() and hdr.size:
+            pa = root_a / path.lstrip("/")
+            pb = root_b / path.lstrip("/")
+            assert pa.read_bytes() == pb.read_bytes(), path
+
+
+def test_alpine_pull_then_push_preserves_bytes(tmp_path, alpine):
+    """Pull from one registry, push to another: the blobs that arrive
+    are byte-identical to the docker-produced originals."""
+    manifest_bytes, config, layer = alpine
+    src = RegistryFixture()
+    _serve_verbatim(src, "library/alpine", "latest", manifest_bytes,
+                    {"c": config, "l": layer})
+    store = ImageStore(str(tmp_path / "store"))
+    name = ImageName("registry.test", "library/alpine", "latest")
+    _client(store, src).pull(name)
+
+    dst = RegistryFixture()
+    dst_client = RegistryClient(store, "mirror.test", "library/alpine",
+                                config=RegistryConfig(), transport=dst)
+    dst_client.push(ImageName("mirror.test", "library/alpine", "latest"))
+    config_hex = hashlib.sha256(config).hexdigest()
+    layer_hex = hashlib.sha256(layer).hexdigest()
+    assert dst.blobs[config_hex] == config
+    assert dst.blobs[layer_hex] == layer
+    pushed = DistributionManifest.from_bytes(
+        dst.manifests["library/alpine:latest"])
+    assert pushed.config.digest.hex() == config_hex
+    assert [d.hex() for d in pushed.layer_digests()] == [layer_hex]
+
+
+def _busybox_save_tar(tmp_path) -> str:
+    """Assemble the reference's on-disk docker-save layout into a tar
+    (read-only source; byte-for-byte member content)."""
+    src = os.path.join(_FILES, "busybox")
+    out = str(tmp_path / "busybox-save.tar")
+    with tarfile.open(out, "w") as tw:
+        for dirpath, _dirnames, filenames in os.walk(src):
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                arc = os.path.relpath(full, src)
+                ti = tarfile.TarInfo(arc)
+                ti.size = os.path.getsize(full)
+                with open(full, "rb") as f:
+                    tw.addfile(ti, f)
+    return out
+
+
+def test_busybox_docker_save_import_and_reexport(tmp_path):
+    """The reference's legacy docker-save layout (manifest.json +
+    v1-style config + <id>/layer.tar) imports, and re-exporting yields a
+    loadable tar with the identical layer content."""
+    from makisu_tpu.docker.save import load_save_tar, write_save_tar
+    save_tar = _busybox_save_tar(tmp_path)
+    store = ImageStore(str(tmp_path / "store"))
+    name = ImageName("", "busybox", "test-build-engine")
+    manifest = load_save_tar(store, save_tar, name)
+    config_bytes = _read("busybox/411a417c1f6ef5b93fac71c92276013f457"
+                         "62dde0bb36a80a6148ca114d1b0fa.json")
+    assert manifest.config.digest.hex() \
+        == hashlib.sha256(config_bytes).hexdigest()
+    layer_tar = _read("busybox/393ccd5c4dd90344c9d725125e13f636ce0087c"
+                      "62f5ca89050faaacbb9e3ed5b/layer.tar")
+    # Layer got gzipped into the store; gunzipping restores the bytes.
+    blob_path = store.layers.path(manifest.layers[0].digest.hex())
+    with open(blob_path, "rb") as f:
+        assert gzip.decompress(f.read()) == layer_tar
+
+    out = str(tmp_path / "reexport.tar")
+    write_save_tar(store, name, out)
+    with tarfile.open(out) as tf:
+        export = json.load(tf.extractfile("manifest.json"))
+        assert export[0]["RepoTags"] == ["busybox:test-build-engine"]
+        member = export[0]["Layers"][0]
+        assert tf.extractfile(member).read() == layer_tar
+        cfg = tf.extractfile(export[0]["Config"]).read()
+        assert cfg == config_bytes
