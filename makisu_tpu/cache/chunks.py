@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import gzip as gzip_mod
 import hashlib
-import io
 import os
 
 import json
@@ -77,6 +76,13 @@ class ChunkStore:
             _CASOnlyStore(self.cas), layer_client.registry,
             layer_client.repository, config=layer_client.config,
             transport=layer_client.transport)
+        # Passing transport explicitly makes the new client treat it as
+        # injected and pin cross-origin redirects to it; mirror the
+        # layer client's actual redirect policy instead (public-CA
+        # transport for S3/GCS-backed registries, unless
+        # trust_redirects / a genuinely injected transport says
+        # otherwise).
+        self.registry.cdn_transport = layer_client.cdn_transport
 
     def has(self, hex_digest: str) -> bool:
         if self.cas.exists(hex_digest):
@@ -207,47 +213,107 @@ class ChunkStore:
         return added
 
     def coverage(self, chunks: list[tuple[int, int, str]]) -> float:
-        """Fraction of the layer's bytes already present as chunks."""
+        """Fraction of the layer's bytes already present as LOCAL
+        chunks. Deliberately never consults the remote plane: has()
+        falls through to a synchronous registry pull per miss, so a
+        remote-checking probe over a 100k-chunk layer would issue 100k
+        sequential HTTP round trips just to report a number."""
         total = sum(length for _, length, _ in chunks)
         if total == 0:
             return 1.0
         have = sum(length for _, length, hex_digest in chunks
-                   if self.has(hex_digest))
+                   if self.cas.exists(hex_digest))
         return have / total
+
+    def reconstitute_to_path(self, pair: DigestPair,
+                             chunks: list[tuple[int, int, str]],
+                             gz_backend: str | None = None) -> str | None:
+        """Rebuild a layer blob from chunks into a temp file; verify
+        both digests. Returns the temp path (caller owns/unlinks it) or
+        None if any chunk is missing or a digest mismatches.
+
+        Streaming discipline matches index_layer: chunk bytes flow
+        chunk-by-chunk through the deterministic gzip writer with both
+        digests updated incrementally, so peak memory is bounded by the
+        largest chunk — a 10GB layer (BASELINE config 4) never
+        materializes in RAM."""
+        import tempfile
+        tar_digest = hashlib.sha256()
+        pos = 0
+        # Temp file lives beside the chunk CAS (not $TMPDIR, commonly
+        # tmpfs): a 10GB layer must hit disk once, and the destination
+        # CAS's link_file can usually hardlink instead of copying.
+        fd, tmp = tempfile.mkstemp(prefix="reconstitute-",
+                                   dir=self.cas._tmp_dir)
+        try:
+            with os.fdopen(fd, "wb") as raw:
+                tee = tario.TeeDigest(raw)
+                gz = tario.gzip_writer(tee, backend_id=gz_backend)
+                failed = False
+                try:
+                    for offset, length, hex_digest in chunks:
+                        if offset != pos or not self.has(hex_digest):
+                            if offset != pos:
+                                log.warning("chunk list has a gap at %d "
+                                            "(expected %d)", offset, pos)
+                            failed = True
+                            break
+                        with self.cas.open(hex_digest) as f:
+                            remaining = length
+                            while remaining > 0:
+                                piece = f.read(min(remaining, 1 << 20))
+                                if not piece:
+                                    log.warning(
+                                        "chunk %s shorter than its "
+                                        "recorded length", hex_digest)
+                                    failed = True
+                                    break
+                                tar_digest.update(piece)
+                                gz.write(piece)
+                                remaining -= len(piece)
+                        if failed:
+                            break
+                        pos = offset + length
+                    if (not failed
+                            and tar_digest.hexdigest()
+                            != pair.tar_digest.hex()):
+                        log.warning("reconstituted stream digest mismatch "
+                                    "for %s", pair.tar_digest)
+                        failed = True
+                finally:
+                    # Always close (trailer into a file we may delete is
+                    # harmless; an unclosed compressor would try writing
+                    # at gc time after raw is gone).
+                    gz.close()
+            if failed:
+                return None
+            if tee.digest.hexdigest() != pair.gzip_descriptor.digest.hex():
+                # Different compression level/implementation produced the
+                # original blob; the bytes are right but the registry
+                # identity isn't. Refuse rather than corrupt the CAS.
+                log.warning("reconstituted gzip digest mismatch for %s "
+                            "(compression settings differ?)",
+                            pair.gzip_descriptor.digest)
+                return None
+            keep, tmp = tmp, None
+            return keep
+        finally:
+            if tmp is not None:
+                os.unlink(tmp)
 
     def reconstitute(self, pair: DigestPair,
                      chunks: list[tuple[int, int, str]],
                      gz_backend: str | None = None) -> bytes | None:
-        """Rebuild a layer blob from chunks; verify both digests.
-        Returns None if any chunk is missing."""
-        parts: list[bytes] = []
-        pos = 0
-        for offset, length, hex_digest in chunks:
-            if offset != pos or not self.has(hex_digest):
-                if offset != pos:
-                    log.warning("chunk list has a gap at %d (expected %d)",
-                                offset, pos)
-                return None
-            parts.append(self.get(hex_digest))
-            pos = offset + length
-        stream = b"".join(parts)
-        if Digest.of_bytes(stream) != pair.tar_digest:
-            log.warning("reconstituted stream digest mismatch for %s",
-                        pair.tar_digest)
+        """Bytes-returning convenience over reconstitute_to_path (tests
+        and small layers; the cache pull path links the file instead)."""
+        path = self.reconstitute_to_path(pair, chunks, gz_backend)
+        if path is None:
             return None
-        out = io.BytesIO()
-        with tario.gzip_writer(out, backend_id=gz_backend) as gz:
-            gz.write(stream)
-        blob = out.getvalue()
-        if Digest.of_bytes(blob) != pair.gzip_descriptor.digest:
-            # Different compression level/implementation produced the
-            # original blob; the bytes are right but the registry identity
-            # isn't. Refuse rather than corrupt the CAS.
-            log.warning("reconstituted gzip digest mismatch for %s "
-                        "(compression settings differ?)",
-                        pair.gzip_descriptor.digest)
-            return None
-        return blob
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
 
 
 def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
@@ -323,13 +389,16 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
             pair, chunks = decode_entry(raw)
             if pair is None or not chunks:
                 raise
-            blob = chunk_store.reconstitute(
+            path = chunk_store.reconstitute_to_path(
                 pair, [tuple(c) for c in chunks],
                 gz_backend=entry_gzip_backend(raw))
-            if blob is None:
+            if path is None:
                 raise
-            manager.store.layers.write_bytes(
-                pair.gzip_descriptor.digest.hex(), blob)
+            try:
+                manager.store.layers.link_file(
+                    pair.gzip_descriptor.digest.hex(), path)
+            finally:
+                os.unlink(path)
             log.info("reconstituted layer %s from %d cached chunks",
                      pair.gzip_descriptor.digest.hex(), len(chunks))
             return pair

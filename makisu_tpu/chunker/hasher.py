@@ -46,23 +46,6 @@ class LayerCommit:
         return [c.hex_digest for c in self.chunks]
 
 
-class _TeeDigest:
-    """File-like fanning writes to a digest and an underlying file."""
-
-    def __init__(self, out: BinaryIO) -> None:
-        self.out = out
-        self.digest = hashlib.sha256()
-        self.size = 0
-
-    def write(self, data: bytes) -> int:
-        self.digest.update(data)
-        self.size += len(data)
-        return self.out.write(data)
-
-    def flush(self) -> None:
-        self.out.flush()
-
-
 class LayerSink:
     """CPU layer sink: gzip + (tar digest, gzip digest) streaming.
 
@@ -79,7 +62,7 @@ class LayerSink:
                  threaded: bool | None = None) -> None:
         import os as _os
         self._tar_digest = hashlib.sha256()
-        self._tee = _TeeDigest(out)
+        self._tee = tario.TeeDigest(out)
         self.backend_id = backend_id or tario.gzip_backend_id()
         self._gz = tario.gzip_writer(self._tee, backend_id=self.backend_id)
         self._closed = False

@@ -13,9 +13,29 @@ same compression level always produce identical gzip bytes.
 from __future__ import annotations
 
 import gzip
+import hashlib
 import os
 import tarfile
 from typing import BinaryIO
+
+
+class TeeDigest:
+    """File-like fanning writes to a sha256 digest and an underlying
+    file (the commit pipeline's gzip-digest tap and chunk
+    reconstitution both hash-while-writing through this)."""
+
+    def __init__(self, out: BinaryIO) -> None:
+        self.out = out
+        self.digest = hashlib.sha256()
+        self.size = 0
+
+    def write(self, data: bytes) -> int:
+        self.digest.update(data)
+        self.size += len(data)
+        return self.out.write(data)
+
+    def flush(self) -> None:
+        self.out.flush()
 
 # Compression levels mirror the reference's flag surface
 # (no/speed/default/size → tario.CompressionLevel, gzip.go:26-47).

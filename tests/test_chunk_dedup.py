@@ -217,3 +217,114 @@ def test_chunks_survive_registry_gc(tmp_path):
     assert [str(l.digest) for l in m1.layers] == \
         [str(l.digest) for l in m2.layers]
     assert store_b.layers.exists(layer_hex)
+
+
+def test_reconstitute_streams_with_bounded_memory(tmp_path):
+    """The warm-cache reconstitution path (BASELINE config 4: 10GB
+    layers) must not materialize the layer: peak Python heap growth
+    while rebuilding a 64MiB layer stays bounded by chunk size, not
+    layer size (matching index_layer's streaming discipline)."""
+    import hashlib
+    import io
+    import os
+    import tracemalloc
+
+    import numpy as np
+
+    from makisu_tpu import tario
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_LAYER,
+        Descriptor,
+        Digest,
+        DigestPair,
+    )
+
+    total = 64 * 1024 * 1024
+    chunk_len = 256 * 1024
+    payload = np.random.default_rng(7).integers(
+        0, 256, size=total, dtype=np.uint8).tobytes()
+    backend = "zlib-1"
+    buf = io.BytesIO()
+    with tario.gzip_writer(buf, backend_id=backend) as gz:
+        gz.write(payload)
+    blob = buf.getvalue()
+    pair = DigestPair(
+        tar_digest=Digest.of_bytes(payload),
+        gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, len(blob),
+                                   Digest.of_bytes(blob)))
+    store = ChunkStore(str(tmp_path / "chunks"))
+    triples = []
+    for off in range(0, total, chunk_len):
+        piece = payload[off:off + chunk_len]
+        hex_digest = hashlib.sha256(piece).hexdigest()
+        store.put(hex_digest, piece)
+        triples.append((off, len(piece), hex_digest))
+    del payload, buf
+
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    path = store.reconstitute_to_path(pair, triples, gz_backend=backend)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert path is not None
+    try:
+        with open(path, "rb") as f:
+            assert f.read() == blob
+    finally:
+        os.unlink(path)
+    # 16MiB headroom for a 64MiB layer: fails loudly if anyone
+    # reintroduces whole-layer buffering.
+    assert peak < 16 * 1024 * 1024, f"peak heap {peak} bytes"
+
+
+def test_strict_registry_degrades_chunk_dedup_not_builds(tmp_path):
+    """A policy-enforcing registry that rejects the chunk-pin manifest's
+    custom media type (MANIFEST_INVALID) must cost only the distributed
+    chunk dedup — never the build. After GC evaporates the unpinned
+    chunks, a fresh builder falls back to building from context and
+    produces the identical image."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    payload = np.random.default_rng(21).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture(strict_media_types=True)
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "blob.bin").write_bytes(payload)
+
+    def one_builder(tag, store_name, chunk_name):
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/strict",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        attach_chunk_dedup(mgr, str(tmp_path / chunk_name))
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/strict", tag), [], mgr,
+                         stages, allow_modify_fs=False, force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        return manifest, store
+
+    m1, _ = one_builder("a", "store-a", "chunks-a")
+    layer_hex = m1.layers[0].digest.hex()
+    # The pin was REJECTED: no pin manifest landed.
+    pin_tag = f"cache/strict:makisu-chunks-{layer_hex[:40]}"
+    assert pin_tag not in fixture.manifests
+    # GC therefore deletes chunks and layer alike — dedup fully degraded.
+    fixture.gc()
+    assert not fixture.blobs
+    # A fresh builder still succeeds (rebuild from context) and produces
+    # the byte-identical image.
+    m2, store_b = one_builder("b", "store-b", "chunks-b")
+    assert [str(l.digest) for l in m1.layers] == \
+        [str(l.digest) for l in m2.layers]
+    assert store_b.layers.exists(layer_hex)
