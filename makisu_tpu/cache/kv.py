@@ -94,28 +94,144 @@ class FSStore:
             self._persist_locked()
 
 
+class RedisError(Exception):
+    """Server-reported redis error (RESP '-' reply)."""
+
+
+class _RespConnection:
+    """Minimal RESP2 client connection: enough protocol for the cache
+    plane (AUTH, GET, SET..EX) with no client-library dependency. One
+    request/response at a time; callers serialize via their own lock.
+
+    Error discipline: any transport failure (timeout mid-reply, dropped
+    socket) leaves the stream position unknowable, so the socket and
+    buffer are discarded immediately and the NEXT command re-dials.
+    Without this, a retried GET would consume the stale reply to the
+    previous command and every later reply would be off by one —
+    silently returning the wrong cache entry for a key."""
+
+    def __init__(self, host: str, port: int, password: str = "",
+                 timeout: float = 10.0) -> None:
+        self._host = host
+        self._port = port
+        self._password = password
+        self._timeout = timeout
+        self._sock = None
+        self._buf = b""
+        self._connect()  # fail fast on bad address/credentials
+
+    def _connect(self) -> None:
+        import socket
+        self._buf = b""
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        if self._password:
+            try:
+                self._exchange("AUTH", self._password)
+            except Exception:
+                self._teardown()
+                raise
+
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # pragma: no cover - best effort
+                pass
+        self._sock = None
+        self._buf = b""
+
+    def close(self) -> None:
+        self._teardown()
+
+    def command(self, *parts: str | bytes):
+        if self._sock is None:
+            self._connect()
+        try:
+            return self._exchange(*parts)
+        except RedisError:
+            raise  # server-level error; the stream stays in sync
+        except Exception:
+            # Timeout / reset / malformed framing: connection state is
+            # unknown — never reuse it.
+            self._teardown()
+            raise
+
+    def _exchange(self, *parts: str | bytes):
+        out = [b"*%d\r\n" % len(parts)]
+        for p in parts:
+            if isinstance(p, str):
+                p = p.encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+        self._sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def _read_until_crlf(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            piece = self._sock.recv(65536)
+            if not piece:
+                raise ConnectionError("redis connection closed mid-reply")
+            self._buf += piece
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:  # payload + trailing CRLF
+            piece = self._sock.recv(65536)
+            if not piece:
+                raise ConnectionError("redis connection closed mid-bulk")
+            self._buf += piece
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def _read_reply(self):
+        line = self._read_until_crlf()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            return (None if n == -1
+                    else [self._read_reply() for _ in range(n)])
+        raise ConnectionError(f"malformed RESP reply {line[:40]!r}")
+
+
 class RedisStore:
-    """Redis-backed store with TTL (reference: redis_store.go). The redis
-    client is imported lazily so CPU-only deployments need no extra deps."""
+    """Redis-backed store with TTL (reference: redis_store.go, tested
+    there against embedded miniredis — go.mod:9). Speaks RESP2 directly
+    over a socket: the cache plane needs only GET / SET..EX / AUTH, so
+    a client-library dependency would be dead weight on CPU-only
+    deployments (and untestable where pip is unavailable)."""
 
     def __init__(self, addr: str, ttl_seconds: float = 336 * 3600,
-                 password: str = "") -> None:
-        import redis  # deferred: optional dependency
+                 password: str = "", timeout: float = 10.0) -> None:
         host, _, port = addr.partition(":")
-        self._client = redis.Redis(host=host,
-                                   port=int(port) if port else 6379,
-                                   password=password or None)
+        self._conn = _RespConnection(host, int(port) if port else 6379,
+                                     password=password, timeout=timeout)
+        self._lock = threading.Lock()
         self.ttl = int(ttl_seconds)
 
     def get(self, key: str) -> str | None:
-        val = self._client.get(key)
+        with self._lock:
+            val = self._conn.command("GET", key)
         return val.decode() if val is not None else None
 
     def put(self, key: str, value: str) -> None:
-        self._client.set(key, value, ex=self.ttl)
+        with self._lock:
+            self._conn.command("SET", key, value, "EX", str(self.ttl))
 
     def cleanup(self) -> None:
         pass  # redis expires keys itself
+
+    def close(self) -> None:
+        self._conn.close()
 
 
 class HTTPStore:
