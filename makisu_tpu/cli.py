@@ -63,7 +63,8 @@ def make_parser() -> argparse.ArgumentParser:
                             "explicit mode)")
     build.add_argument("--blacklist", action="append", default=[],
                        help="extra paths to exclude from layers")
-    build.add_argument("--local-cache-ttl", default="168h")
+    # Reference default: 14 days (bin/makisu/cmd/build.go:113-117).
+    build.add_argument("--local-cache-ttl", default="336h")
     build.add_argument("--redis-cache-addr", default="")
     build.add_argument("--redis-cache-password", default="")
     build.add_argument("--http-cache-addr", default="")
@@ -259,14 +260,17 @@ class _FromPuller:
 
 def cmd_pull(args) -> int:
     from makisu_tpu.docker.image import ImageName
-    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.registry import load_config_map, new_client
     from makisu_tpu.storage import ImageStore
 
-    if args.registry_config:
-        update_global_config(args.registry_config)
+    # Per-command map, not update_global_config: the worker serves
+    # pull/push/diff concurrently with builds, and mutating the
+    # process-global map would race other requests' config_for lookups.
+    config_map = (load_config_map(args.registry_config)
+                  if args.registry_config else None)
     name = ImageName.parse_for_pull(args.image)
     with ImageStore(_storage_dir(args.storage)) as store:
-        manifest = new_client(store, name).pull(name)
+        manifest = new_client(store, name, config_map=config_map).pull(name)
         log.info("pulled %s (%d layers)", name, len(manifest.layers))
         if args.extract:
             from makisu_tpu.snapshot import MemFS
@@ -282,11 +286,11 @@ def cmd_pull(args) -> int:
 def cmd_push(args) -> int:
     from makisu_tpu.docker.image import ImageName
     from makisu_tpu.docker.save import load_save_tar
-    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.registry import load_config_map, new_client
     from makisu_tpu.storage import ImageStore
 
-    if args.registry_config:
-        update_global_config(args.registry_config)
+    config_map = (load_config_map(args.registry_config)
+                  if args.registry_config else None)
     name = ImageName.parse(args.tag)
     with ImageStore(_storage_dir(args.storage)) as store:
         load_save_tar(store, args.tar_path, name)
@@ -295,7 +299,7 @@ def cmd_push(args) -> int:
                 raise SystemExit("no registry to push to (use --push)")
             target = name.with_registry(registry)
             store.manifests.save(target, store.manifests.load(name))
-            new_client(store, target).push(target)
+            new_client(store, target, config_map=config_map).push(target)
             log.info("pushed %s", target)
     return 0
 
@@ -304,18 +308,19 @@ def cmd_diff(args) -> int:
     import tempfile
 
     from makisu_tpu.docker.image import ImageName
-    from makisu_tpu.registry import new_client, update_global_config
+    from makisu_tpu.registry import load_config_map, new_client
     from makisu_tpu.snapshot import MemFS
     from makisu_tpu.storage import ImageStore
 
-    if args.registry_config:
-        update_global_config(args.registry_config)
+    config_map = (load_config_map(args.registry_config)
+                  if args.registry_config else None)
     with ImageStore(_storage_dir(args.storage)) as store:
         trees = []
         configs = []
         for image in args.images:
             name = ImageName.parse_for_pull(image)
-            manifest = new_client(store, name).pull(name)
+            manifest = new_client(store, name,
+                                  config_map=config_map).pull(name)
             with store.layers.open(manifest.config.digest.hex()) as f:
                 import json as json_mod
 

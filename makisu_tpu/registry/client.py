@@ -323,17 +323,32 @@ class RegistryClient:
         try:
             resp = self._send("GET", f"{self._base()}/blobs/{digest}",
                               accepted=(200,) + redirects, stream_to=tmp)
-            if resp.status in redirects:
-                # Follow the redirect (Docker Hub / S3 / GCS-backed
-                # registries offload blob GETs this way); the target
-                # streams the real blob into tmp. Never consult the
-                # redirect response's own body: it is an HTML stub
-                # (Go's http.Redirect writes one for GET) and must not
-                # clobber the blob.
-                location = self._absolute(resp.header("location"))
+            # Follow redirects (Docker Hub / S3 / GCS-backed registries
+            # offload blob GETs this way); the final target streams the
+            # real blob into tmp. Chains of more than one hop happen in
+            # the wild (distribution behind CDN fronting: 302 → 302 →
+            # 200), so loop with a bound rather than following exactly
+            # one Location. Never consult a redirect response's own
+            # body: it is an HTML stub (Go's http.Redirect writes one
+            # for GET) and must not clobber the blob.
+            current = f"{self._base()}/blobs/{digest}"
+            hops = 0
+            while resp.status in redirects:
+                hops += 1
+                if hops > 5:
+                    raise ValueError(
+                        f"blob {digest}: more than 5 redirect hops")
+                # Relative Locations resolve against the hop that issued
+                # them (a CDN's relative redirect must not bounce back
+                # to the registry origin).
+                from urllib.parse import urljoin
+                location = urljoin(current, resp.header("location"))
+                current = location
                 if self._same_origin(location):
                     # Same registry: keep auth (and the 401 token dance).
-                    resp = self._send("GET", location, stream_to=tmp)
+                    resp = self._send("GET", location,
+                                      accepted=(200,) + redirects,
+                                      stream_to=tmp)
                 else:
                     # Cross-origin presigned URL (S3/GCS): forwarding
                     # registry credentials would leak them, and the
@@ -341,7 +356,8 @@ class RegistryClient:
                     resp = send(
                         self.cdn_transport, "GET", location, {},
                         retries=self.config.retries,
-                        timeout=self.config.timeout, stream_to=tmp)
+                        timeout=self.config.timeout, stream_to=tmp,
+                        accepted=(200,) + redirects)
             if resp.status == 200 and resp.body:
                 # Transport without streaming support (fixtures).
                 with open(tmp, "wb") as f:

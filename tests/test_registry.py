@@ -282,3 +282,41 @@ def test_pull_manifest_rejects_zstd_layers(store, fixture):
     fixture.manifests["team/app:zstd"] = json_mod.dumps(raw).encode()
     with pytest.raises(ValueError, match="layer media type"):
         client(store, fixture).pull_manifest("zstd")
+
+
+def test_blob_redirect_chain_followed(store, fixture):
+    """CDN-fronted registries produce multi-hop chains (302 -> 302 ->
+    200); pull_layer follows them bounded instead of erroring after one
+    hop."""
+    manifest, config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "v1", manifest, blobs)
+    layer_hex = manifest.layers[0].digest.hex()
+    blob_url = f".*/blobs/sha256:{layer_hex}$"
+    fixture.override("GET", blob_url, Response(302, {"location": "/hop1"},
+                                               b"<html>moved</html>"))
+    fixture.override(
+        "GET", "/hop1$",
+        Response(302, {"location":
+                       f"/v2/team/app/blobs/sha256:{layer_hex}"},
+                 b"<html>moved again</html>"))
+    c = client(store, fixture)
+    path = c.pull_layer(manifest.layers[0].digest)
+    import hashlib
+    with open(path, "rb") as f:
+        assert hashlib.sha256(f.read()).hexdigest() == layer_hex
+
+
+def test_blob_redirect_loop_bounded(store, fixture):
+    manifest, config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "v1", manifest, blobs)
+    layer_hex = manifest.layers[0].digest.hex()
+    blob_url = f".*/blobs/sha256:{layer_hex}$"
+    for _ in range(7):
+        fixture.override(
+            "GET", blob_url,
+            Response(302, {"location":
+                           f"/v2/team/app/blobs/sha256:{layer_hex}"},
+                     b""))
+    c = client(store, fixture)
+    with pytest.raises(ValueError, match="redirect hops"):
+        c.pull_layer(manifest.layers[0].digest)
