@@ -67,7 +67,8 @@ class RegistryFixture(Transport):
     """In-process registry: blobs/manifests in dicts, full upload state
     machine, per-(method,url-regex) response overrides."""
 
-    def __init__(self, require_token: str = "") -> None:
+    def __init__(self, require_token: str = "",
+                 strict_media_types: bool = False) -> None:
         super().__init__()
         self.blobs: dict[str, bytes] = {}          # hex → blob
         self.manifests: dict[str, bytes] = {}      # "<repo>:<tag>" → json
@@ -78,6 +79,17 @@ class RegistryFixture(Transport):
         # When set, /v2/ endpoints demand "Bearer <require_token>" and
         # 401-challenge to /token (exercises the auth dance).
         self.require_token = require_token
+        # Strict registries (policy-enforcing Harbor/quay setups) reject
+        # manifests whose layers carry media types they don't know —
+        # including this framework's chunk-pin manifests. Tests flip
+        # this on to prove builds degrade gracefully instead of failing.
+        self.strict_media_types = strict_media_types
+
+    _KNOWN_LAYER_TYPES = (
+        MEDIA_TYPE_LAYER,
+        "application/vnd.oci.image.layer.v1.tar+gzip",
+        "application/vnd.docker.image.rootfs.foreign.diff.tar.gzip",
+    )
 
     # -- test wiring ------------------------------------------------------
 
@@ -143,7 +155,24 @@ class RegistryFixture(Transport):
                     return Response(200, {}, self.manifests[key])
                 return Response(404, {}, b"manifest unknown")
             if method == "PUT":
-                self.manifests[key] = bytes(body or b"")
+                payload = bytes(body or b"")
+                if self.strict_media_types:
+                    try:
+                        parsed = json.loads(payload)
+                    except ValueError:
+                        return Response(400, {}, b"MANIFEST_INVALID")
+                    bad = [l.get("mediaType")
+                           for l in parsed.get("layers") or []
+                           if l.get("mediaType")
+                           not in self._KNOWN_LAYER_TYPES]
+                    if bad:
+                        return Response(
+                            400, {},
+                            json.dumps({"errors": [{
+                                "code": "MANIFEST_INVALID",
+                                "message": f"unknown layer media "
+                                           f"types {bad[:3]}"}]}).encode())
+                self.manifests[key] = payload
                 return Response(201, {}, b"")
             if method == "HEAD":
                 status = 200 if key in self.manifests else 404

@@ -1,0 +1,153 @@
+"""Opt-in end-to-end suite against a REAL registry implementation.
+
+The reference's tier-3 suite boots two `registry:2` containers and
+builds 16 contexts through them (test/python/conftest.py:20-40 +
+test_build.py). This environment has no docker, so the suite is opt-in:
+
+    REGISTRY_ADDR=localhost:5000 python -m pytest tests/test_e2e_real_registry.py
+
+(e.g. after `docker run -d -p 5000:5000 registry:2`). Every test
+builds a context, pushes the image to the real registry over real HTTP,
+pulls it back into a fresh store, and verifies digests — the
+wire-compatibility claims the hermetic fixture cannot prove.
+
+RUN-directive contexts additionally modify the filesystem; they are
+skipped unless MAKISU_E2E_MODIFYFS=1 (set it inside a container/chroot
+you are happy to have written to).
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from makisu_tpu.builder import BuildPlan
+from makisu_tpu.cache import NoopCacheManager
+from makisu_tpu.context import BuildContext
+from makisu_tpu.docker.image import ImageName
+from makisu_tpu.dockerfile import parse_file
+from makisu_tpu.registry import RegistryClient
+from makisu_tpu.storage import ImageStore
+
+REGISTRY = os.environ.get("REGISTRY_ADDR", "")
+MODIFYFS = os.environ.get("MAKISU_E2E_MODIFYFS") == "1"
+
+pytestmark = pytest.mark.skipif(
+    not REGISTRY, reason="opt-in: set REGISTRY_ADDR to a real registry:2")
+
+# The 16 contexts (mirroring the reference's testdata/build-context
+# scenarios): (name, dockerfile, files, needs_modifyfs).
+CONTEXTS = [
+    ("simple-copy", "FROM scratch\nCOPY a.txt /a.txt\n",
+     {"a.txt": "alpha"}, False),
+    ("copy-dir", "FROM scratch\nCOPY sub /app/sub/\n",
+     {"sub/one.txt": "1", "sub/two.txt": "2"}, False),
+    ("copy-glob", "FROM scratch\nCOPY *.cfg /etc/app/\n",
+     {"x.cfg": "x", "y.cfg": "y", "skip.txt": "no"}, False),
+    ("copy-chown", "FROM scratch\nCOPY --chown=1000:1000 a.txt /a.txt\n",
+     {"a.txt": "owned"}, True),  # --chown requires --modifyfs
+    ("copy-from", "FROM scratch AS builder\nCOPY a.txt /built.txt\n"
+     "FROM scratch\nCOPY --from=builder /built.txt /final.txt\n",
+     {"a.txt": "staged"}, True),  # COPY --from requires --modifyfs
+    ("symlink", "FROM scratch\nCOPY link /link\nCOPY a.txt /a.txt\n",
+     {"a.txt": "target"}, False),  # link created in _materialize
+    ("arg-env", "ARG WHO=world\nFROM scratch\nARG WHO\n"
+     "ENV GREETING=hello-$WHO\nCOPY a.txt /a.txt\n",
+     {"a.txt": "argenv"}, False),
+    ("metadata", "FROM scratch\nCOPY a.txt /a.txt\nENV A=1 B=2\n"
+     "LABEL team=tpu\nEXPOSE 8080\nVOLUME /data\nWORKDIR /srv\n"
+     "ENTRYPOINT [\"/bin/app\"]\nCMD [\"serve\"]\nUSER 1000\n",
+     {"a.txt": "meta"}, False),
+    ("target-stage", "FROM scratch AS base\nCOPY a.txt /base.txt\n"
+     "FROM scratch AS extra\nCOPY a.txt /extra.txt\n",
+     {"a.txt": "tgt"}, False),
+    ("multi-layer", "FROM scratch\nCOPY a.txt /1.txt\nCOPY a.txt /2.txt\n"
+     "COPY a.txt /3.txt\n",
+     {"a.txt": "layers"}, False),
+    ("add-file", "FROM scratch\nADD a.txt /added.txt\n",
+     {"a.txt": "added"}, False),
+    ("healthcheck", "FROM scratch\nCOPY a.txt /a.txt\n"
+     "HEALTHCHECK --interval=30s CMD [\"/bin/check\"]\n",
+     {"a.txt": "hc"}, False),
+    ("maintainer-stopsignal", "FROM scratch\nCOPY a.txt /a.txt\n"
+     "MAINTAINER makisu-tpu\nSTOPSIGNAL 15\n",
+     {"a.txt": "ms"}, False),  # integer signal: the reference rejects
+     # names too (stopsignal.go "signal must be integer"); and no
+     # ONBUILD context — the reference's parser has no onbuild.go
+    ("run-touch", "FROM scratch\nRUN echo ran > ran.txt\n", {}, True),
+    ("run-env", "FROM scratch\nENV MSG=live\nRUN echo $MSG > msg.txt\n",
+     {}, True),
+    ("run-commit", "FROM scratch\nRUN echo one > one.txt #!COMMIT\n"
+     "RUN echo two > two.txt #!COMMIT\n", {}, True),
+]
+
+
+def _materialize(ctx_dir, files):
+    for rel, content in files.items():
+        p = ctx_dir / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    if "a.txt" in files:  # the symlink context references "link"
+        (ctx_dir / "link").symlink_to("a.txt")
+
+
+@pytest.mark.parametrize(
+    "name,dockerfile,files,needs_modifyfs",
+    CONTEXTS, ids=[c[0] for c in CONTEXTS])
+def test_context_builds_pushes_and_pulls_back(tmp_path, name, dockerfile,
+                                              files, needs_modifyfs):
+    if needs_modifyfs and not MODIFYFS:
+        pytest.skip("RUN context: set MAKISU_E2E_MODIFYFS=1")
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    _materialize(ctx_dir, files)
+    root = tmp_path / "root"
+    root.mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    repo = f"makisu-e2e/{name}"
+    image = ImageName(REGISTRY, repo, "r3")
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    plan = BuildPlan(
+        ctx, image, [], NoopCacheManager(),
+        parse_file(dockerfile), allow_modify_fs=needs_modifyfs,
+        force_commit=False,
+        stage_target="base" if name == "target-stage" else "")
+    manifest = plan.execute()
+    RegistryClient(store, REGISTRY, repo).push(image)
+
+    # Pull back into a FRESH store through the same real registry.
+    back = ImageStore(str(tmp_path / "back"))
+    client = RegistryClient(back, REGISTRY, repo)
+    pulled = client.pull(ImageName(REGISTRY, repo, "r3"))
+    assert [str(l.digest) for l in pulled.layers] \
+        == [str(l.digest) for l in manifest.layers]
+    assert str(pulled.config.digest) == str(manifest.config.digest)
+    for desc in [pulled.config] + list(pulled.layers):
+        with back.layers.open(desc.digest.hex()) as f:
+            assert hashlib.sha256(f.read()).hexdigest() == desc.digest.hex()
+
+
+def test_chunk_pin_manifest_accepted_by_real_registry(tmp_path):
+    """Probe whether the real registry accepts the chunk-pin manifest's
+    custom layer media type. Acceptance enables distributed chunk dedup;
+    rejection is a documented degraded mode (the build path tolerates it
+    — tests/test_chunk_dedup.py::test_strict_registry_degrades_...)."""
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.utils.httputil import HTTPError
+
+    store = ImageStore(str(tmp_path / "store"))
+    client = RegistryClient(store, REGISTRY, "makisu-e2e/chunkpin")
+    chunks = ChunkStore(str(tmp_path / "chunks"))
+    chunks.set_remote(client)
+    payload = b"chunk-pin acceptance probe payload"
+    hex_digest = hashlib.sha256(payload).hexdigest()
+    chunks.put(hex_digest, payload)
+    chunks.push_remote(hex_digest)
+    try:
+        chunks.pin_remote("f" * 64, [(0, len(payload), hex_digest)])
+    except HTTPError as e:
+        pytest.xfail(f"registry rejects chunk media type ({e.status}): "
+                     "distributed chunk dedup degrades to local-only")
+    # Accepted (PUT returned 2xx): distributed chunk dedup is live on
+    # this registry. (The pin manifest is not pull_manifest-compatible
+    # by design — our client rejects non-layer media types on pull.)
