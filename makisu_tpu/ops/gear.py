@@ -90,6 +90,19 @@ def _gear_value(data: jax.Array) -> jax.Array:
     return z ^ (z >> jnp.uint32(15))
 
 
+def _windowed_sum(g: jax.Array) -> jax.Array:
+    """The log-doubling window accumulation over per-byte G-values —
+    THE cache-identity-bearing Gear recurrence. Single definition on
+    purpose: the flat and blocked bitmap paths must cut identical
+    boundaries forever."""
+    h = g
+    m = 1
+    while m < WINDOW:
+        h = h + (_shift_seq(h, m) << jnp.uint32(m))
+        m *= 2
+    return h
+
+
 def gear_hash(data: jax.Array) -> jax.Array:
     """Per-position Gear hashes for uint8 data [..., N].
 
@@ -97,12 +110,7 @@ def gear_hash(data: jax.Array) -> jax.Array:
     treated as starting at index 0 (zero history). For segmented streams
     pass 31 bytes of left halo and drop the first 31 outputs.
     """
-    h = _gear_value(data)
-    m = 1
-    while m < WINDOW:
-        h = h + (_shift_seq(h, m) << jnp.uint32(m))
-        m *= 2
-    return h
+    return _windowed_sum(_gear_value(data))
 
 
 def boundary_mask(h: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
@@ -129,9 +137,76 @@ def unpack_bits_np(words: np.ndarray, n: int) -> np.ndarray:
     return bits[..., :n].astype(bool)
 
 
-@jax.jit
+# Scan-block size for the bandwidth-lean bitmap path. 64KiB of input
+# makes each in-flight intermediate a 256KiB uint32 tile — comfortably
+# VMEM-resident on every TPU generation, large enough to amortize the
+# scan-step overhead.
+SCAN_BLOCK = 64 * 1024
+
+
+def _gear_bitmap_blocked(data: jax.Array, avg_bits: int,
+                         block: int) -> jax.Array:
+    """Same output as pack_bits(boundary_mask(gear_hash(data))) with a
+    fraction of the HBM traffic: the flat path materializes ~6
+    full-stream uint32 arrays (G-values + one per log-doubling step =
+    ~40 bytes of memory traffic per input byte); here a lax.scan walks
+    64KiB blocks carrying the previous block's last 31 G-values as
+    halo, so every intermediate is block-sized and lives in VMEM — the
+    stream itself is only sliced per block (read ~once) and only the 3%
+    bitmap is written. Bit-identical by construction: position i's
+    windowed sum needs only the 31 preceding G-values, which the halo
+    supplies (zeros at stream start = the zero-history convention)."""
+    *batch, n = data.shape
+    rem = n % block
+    mask = jnp.uint32((1 << avg_bits) - 1)
+
+    # Leading remainder (the chunker's intake buffer is halo+blocks,
+    # e.g. 128B + 4MiB): computed flat — it is tiny — and its last 31
+    # G-values seed the scan's halo so the stream stays contiguous.
+    if rem:
+        g_prefix = _gear_value(data[..., :rem])
+        prefix_words = pack_bits((_windowed_sum(g_prefix) & mask) == 0)
+        halo0 = g_prefix[..., -(WINDOW - 1):]
+        data = data[..., rem:]
+    else:
+        halo0 = jnp.zeros((*batch, WINDOW - 1), dtype=jnp.uint32)
+    nb = (n - rem) // block
+
+    def step(halo, i):
+        # dynamic_slice instead of a transposed xs array: scanning a
+        # moveaxis'd copy would materialize a second full read+write of
+        # the input for batched callers.
+        blk = jax.lax.dynamic_slice_in_dim(data, i * block, block,
+                                           axis=data.ndim - 1)
+        g = _gear_value(blk)
+        h = _windowed_sum(jnp.concatenate([halo, g], axis=-1))
+        bits = (h[..., WINDOW - 1:] & mask) == 0
+        return g[..., -(WINDOW - 1):], pack_bits(bits)
+
+    _, words = jax.lax.scan(step, halo0, jnp.arange(nb))
+    words = jnp.moveaxis(words, 0, -2).reshape(*batch, (n - rem) // 32)
+    if rem:
+        words = jnp.concatenate([prefix_words, words], axis=-1)
+    return words
+
+
+@functools.partial(jax.jit, static_argnums=(1,),
+                   static_argnames=("avg_bits",))
 def gear_bitmap(data: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
-    """Fused: uint8 [..., N] -> packed candidate bitmap uint32 [..., N//32]."""
+    """Fused: uint8 [..., N] -> packed candidate bitmap uint32 [..., N//32].
+
+    Streams spanning >= 2 SCAN_BLOCKs (every production buffer: the
+    chunker ships 128B halo + 4MiB blocks) take the blocked
+    low-bandwidth path, with any leading remainder computed flat as a
+    prefix; short streams take the flat path. Both are bit-identical,
+    so the choice is shape-local and identity-free."""
+    n = data.shape[-1]
+    rem = n % SCAN_BLOCK
+    # rem % 32 == 0 (pack_bits needs word-aligned segments) also
+    # guarantees rem is 0 or >= 32 > WINDOW-1, so the prefix always has
+    # enough G-values to seed the scan halo.
+    if n // SCAN_BLOCK >= 2 and rem % 32 == 0:
+        return _gear_bitmap_blocked(data, avg_bits, SCAN_BLOCK)
     return pack_bits(boundary_mask(gear_hash(data), avg_bits))
 
 

@@ -74,3 +74,16 @@ def test_arithmetic_gear_value_matches_table():
     all_bytes = np.arange(256, dtype=np.uint8)
     got = np.asarray(gear._gear_value(jnp.asarray(all_bytes)))
     np.testing.assert_array_equal(got, gear.gear_table())
+
+
+def test_blocked_bitmap_matches_reference_on_production_shape():
+    """The bandwidth-lean lax.scan path (engaged for >=2 SCAN_BLOCK
+    streams, incl. the chunker's halo+4MiB buffers with their 128-byte
+    remainder) must be bit-identical to the sequential reference."""
+    rng = np.random.default_rng(23)
+    n = 128 + 2 * gear.SCAN_BLOCK  # halo + blocks: remainder path
+    data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    words = np.asarray(gear.gear_bitmap(data, 6))
+    href = gear.gear_hash_ref(data.tobytes())
+    want = np.asarray(gear.pack_bits((href & np.uint32(63)) == 0))
+    np.testing.assert_array_equal(words, want)
