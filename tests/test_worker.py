@@ -225,3 +225,43 @@ def test_concurrent_builds_run_in_parallel(tmp_path, worker):
         # the other builds exported theirs concurrently.
         assert _file_from_save_tar(
             str(out), "val.txt") == f"value-{i}".encode()
+
+
+def test_pull_through_worker_with_per_request_config(tmp_path, worker):
+    """The worker serves pull/push/diff too (any CLI argv): a pull with
+    its own --registry-config must succeed without mutating the
+    process-global config map (which concurrent builds read)."""
+    import json
+
+    from makisu_tpu.registry import make_test_image
+    from makisu_tpu.registry.client import set_transport_factory
+    from makisu_tpu.registry.config import _global_config
+    from makisu_tpu.registry.fixtures import RegistryFixture
+
+    fixture = RegistryFixture()
+    manifest, _config_blob, blobs = make_test_image()
+    fixture.serve_image("team/app", "v1", manifest, blobs)
+    set_transport_factory(lambda name: fixture)
+    try:
+        before = json.dumps(_global_config, default=str, sort_keys=True)
+        cfg = tmp_path / "registry.yaml"
+        cfg.write_text(json.dumps(
+            {"registry.test": {"team/*": {"security": {
+                "tls": {"client": {"disabled": True}}}}}}))
+        client = WorkerClient(worker.socket_path)
+        code = client.build([
+            "--log-level", "error", "pull", "registry.test/team/app:v1",
+            "--storage", str(tmp_path / "storage"),
+            "--registry-config", str(cfg),
+        ])
+        assert code == 0
+        # The layer actually landed.
+        import os
+        layers_dir = tmp_path / "storage" / "layers"
+        assert any(files for _, _, files in os.walk(layers_dir))
+        # And the process-global map is untouched (no cross-request
+        # contamination inside the long-lived worker).
+        after = json.dumps(_global_config, default=str, sort_keys=True)
+        assert after == before
+    finally:
+        set_transport_factory(None)
