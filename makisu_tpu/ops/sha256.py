@@ -62,6 +62,33 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> n) | (x << (jnp.uint32(32) - n))
 
 
+def _apply_padding(msg_bytes: jax.Array, idx: jax.Array,
+                   lengths: jax.Array, total: jax.Array) -> jax.Array:
+    """THE SHA-256 padding formula, shared by the whole-buffer path
+    (pad_lanes) and the fused block-scan path (sha256_lanes): mask the
+    tail, place the 0x80 marker, write the 8-byte big-endian bit
+    length. ``idx`` is each byte's absolute message offset; ``total``
+    is each lane's padded byte count (num_blocks*64).
+
+    Lane capacity is < 2^28 bytes so the bit length's high word needs
+    only bits 29..31 of the byte length; everything stays in uint32.
+    """
+    ln = lengths[..., None]
+    msg = jnp.where(idx < ln, msg_bytes, jnp.uint8(0))
+    msg = jnp.where(idx == ln, jnp.uint8(0x80), msg)
+    off = idx - (total[..., None] - 8)  # 0..7 inside the length field
+    bitlen_lo = (lengths.astype(jnp.uint32) << jnp.uint32(3))[..., None]
+    bitlen_hi = (lengths.astype(jnp.uint32) >> jnp.uint32(29))[..., None]
+    shift_lo = (jnp.uint32(7) - off.astype(jnp.uint32)) << jnp.uint32(3)
+    shift_hi = (jnp.uint32(3) - off.astype(jnp.uint32)) << jnp.uint32(3)
+    len_byte = jnp.where(
+        off >= 4,
+        (bitlen_lo >> (shift_lo & jnp.uint32(31))) & jnp.uint32(0xFF),
+        (bitlen_hi >> (shift_hi & jnp.uint32(31))) & jnp.uint32(0xFF),
+    ).astype(jnp.uint8)
+    return jnp.where((off >= 0) & (off < 8), len_byte, msg)
+
+
 def pad_lanes(data: jax.Array, lengths: jax.Array) -> jax.Array:
     """Apply SHA-256 padding to L ragged messages stored in a fixed buffer.
 
@@ -78,24 +105,8 @@ def pad_lanes(data: jax.Array, lengths: jax.Array) -> jax.Array:
         raise ValueError(f"lane capacity {cap} not a multiple of 64")
     lengths = lengths.astype(jnp.int32)
     idx = jax.lax.broadcasted_iota(jnp.int32, data.shape, data.ndim - 1)
-    ln = lengths[..., None]
-    msg = jnp.where(idx < ln, data, jnp.uint8(0))
-    msg = jnp.where(idx == ln, jnp.uint8(0x80), msg)
-    total = num_blocks(lengths)[..., None] * 64
-    # Big-endian 64-bit bit-length occupies the final 8 bytes of the last
-    # live block. Lane capacity is < 2^28 bytes so the high word needs only
-    # bits 29..31 of the byte length; everything stays in uint32.
-    off = idx - (total - 8)  # 0..7 inside the length field
-    bitlen_lo = (lengths.astype(jnp.uint32) << jnp.uint32(3))[..., None]
-    bitlen_hi = (lengths.astype(jnp.uint32) >> jnp.uint32(29))[..., None]
-    shift_lo = (jnp.uint32(7) - off.astype(jnp.uint32)) << jnp.uint32(3)
-    shift_hi = (jnp.uint32(3) - off.astype(jnp.uint32)) << jnp.uint32(3)
-    len_byte = jnp.where(
-        off >= 4,
-        (bitlen_lo >> (shift_lo & jnp.uint32(31))) & jnp.uint32(0xFF),
-        (bitlen_hi >> (shift_hi & jnp.uint32(31))) & jnp.uint32(0xFF),
-    ).astype(jnp.uint8)
-    return jnp.where((off >= 0) & (off < 8), len_byte, msg)
+    total = num_blocks(lengths) * 64
+    return _apply_padding(data, idx, lengths, total)
 
 
 def num_blocks(lengths: jax.Array) -> jax.Array:
@@ -192,9 +203,35 @@ def sha256_words(words: jax.Array, n_blocks: jax.Array,
 
 @functools.partial(jax.jit, donate_argnums=())
 def sha256_lanes(data: jax.Array, lengths: jax.Array) -> jax.Array:
-    """End-to-end: ragged uint8 lanes [L, CAP] + lengths [L] -> [L, 8] digests."""
-    msg = pad_lanes(data, lengths)
-    return sha256_words(bytes_to_words(msg), num_blocks(lengths))
+    """End-to-end: ragged uint8 lanes [L, CAP] + lengths [L] -> [L, 8] digests.
+
+    Fused block-scan formulation: padding, byteswap, and the [L,64] ->
+    [16,L] tile transpose all happen PER BLOCK inside the scan step, so
+    the only full-size HBM traffic is one uint8 read of the lane buffer
+    (~2 bytes/byte total). The pad_lanes + bytes_to_words + sha256_words
+    composition (kept for the sharded path and as the test reference)
+    materializes the whole buffer as uint32 words plus a transposed
+    copy — ~13 bytes of traffic per input byte."""
+    L, cap = data.shape
+    if cap % 64:
+        raise ValueError(f"lane capacity {cap} not a multiple of 64")
+    lengths = lengths.astype(jnp.int32)
+    nb = num_blocks(lengths)
+    total = nb * 64
+    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, L))
+
+    def step(state, b):
+        blk = jax.lax.dynamic_slice_in_dim(data, b * 64, 64, axis=1)
+        idx = b * 64 + jax.lax.broadcasted_iota(jnp.int32, (L, 64), 1)
+        msg = _apply_padding(blk, idx, lengths, total)
+        w16 = bytes_to_words(msg)[:, 0]  # [L, 64] is one block: NB=1
+        new = _compress(state, jnp.transpose(w16))
+        keep = (b < nb)[None, :]
+        return jnp.where(keep, new, state), None
+
+    state, _ = jax.lax.scan(step, state0,
+                            jnp.arange(cap // 64, dtype=jnp.int32))
+    return jnp.transpose(state)
 
 
 def digest_bytes(words: np.ndarray) -> list[bytes]:

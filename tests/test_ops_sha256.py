@@ -45,3 +45,20 @@ def test_known_vectors():
         "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
     )
     assert out[1] == hashlib.sha256(b"hello world").hexdigest()
+
+
+def test_fused_lanes_match_reference_composition():
+    """sha256_lanes (fused block-scan: padding/byteswap inside the
+    step) must stay digest-identical to the pad_lanes + bytes_to_words
+    + sha256_words composition the sharded path uses."""
+    rng = np.random.default_rng(31)
+    L, cap = 32, 512
+    data = rng.integers(0, 256, size=(L, cap), dtype=np.uint8)
+    lengths = rng.integers(0, cap - 9, size=L, dtype=np.int32)
+    lengths[0] = 0
+    lengths[1] = cap - 9
+    fused = np.asarray(sha256.sha256_lanes(data, lengths))
+    composed = np.asarray(sha256.sha256_words(
+        sha256.bytes_to_words(sha256.pad_lanes(data, lengths)),
+        sha256.num_blocks(lengths)))
+    np.testing.assert_array_equal(fused, composed)
