@@ -144,8 +144,8 @@ def unpack_bits_np(words: np.ndarray, n: int) -> np.ndarray:
 SCAN_BLOCK = 64 * 1024
 
 
-def _gear_bitmap_blocked(data: jax.Array, avg_bits: int,
-                         block: int) -> jax.Array:
+def _gear_bitmap_blocked(data: jax.Array, avg_bits: int, block: int,
+                         halo_g: jax.Array | None = None) -> jax.Array:
     """Same output as pack_bits(boundary_mask(gear_hash(data))) with a
     fraction of the HBM traffic: the flat path materializes ~6
     full-stream uint32 arrays (G-values + one per log-doubling step =
@@ -160,16 +160,20 @@ def _gear_bitmap_blocked(data: jax.Array, avg_bits: int,
     rem = n % block
     mask = jnp.uint32((1 << avg_bits) - 1)
 
+    if halo_g is None:
+        halo_g = jnp.zeros((*batch, WINDOW - 1), dtype=jnp.uint32)
     # Leading remainder (the chunker's intake buffer is halo+blocks,
     # e.g. 128B + 4MiB): computed flat — it is tiny — and its last 31
     # G-values seed the scan's halo so the stream stays contiguous.
     if rem:
         g_prefix = _gear_value(data[..., :rem])
-        prefix_words = pack_bits((_windowed_sum(g_prefix) & mask) == 0)
+        hp = _windowed_sum(
+            jnp.concatenate([halo_g, g_prefix], axis=-1))[..., WINDOW - 1:]
+        prefix_words = pack_bits((hp & mask) == 0)
         halo0 = g_prefix[..., -(WINDOW - 1):]
         data = data[..., rem:]
     else:
-        halo0 = jnp.zeros((*batch, WINDOW - 1), dtype=jnp.uint32)
+        halo0 = halo_g
     nb = (n - rem) // block
 
     def step(halo, i):
@@ -200,14 +204,33 @@ def gear_bitmap(data: jax.Array, avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
     low-bandwidth path, with any leading remainder computed flat as a
     prefix; short streams take the flat path. Both are bit-identical,
     so the choice is shape-local and identity-free."""
+    zero_halo = jnp.zeros((*data.shape[:-1], WINDOW - 1), jnp.uint32)
+    return gear_bitmap_with_halo(data, zero_halo, avg_bits)
+
+
+def gear_bitmap_with_halo(data: jax.Array, halo_g: jax.Array,
+                          avg_bits: int = DEFAULT_AVG_BITS) -> jax.Array:
+    """gear_bitmap for a stream SEGMENT: ``halo_g`` is the G-values of
+    the 31 bytes preceding the segment (zeros = stream start; the
+    zero-halo concat is bit-identical to the flat zero-history
+    computation because _windowed_sum zero-fills its left edge). The
+    seq-sharded mesh path computes each shard's bitmap with exactly one
+    evaluation this way — the neighbor's bytes arrive by ppermute, their
+    G-values are masked to zero on shard 0, and the result is
+    bit-identical to the unsharded stream's bitmap. This is the ONE
+    routing gate between the flat and blocked formulations."""
     n = data.shape[-1]
     rem = n % SCAN_BLOCK
     # rem % 32 == 0 (pack_bits needs word-aligned segments) also
     # guarantees rem is 0 or >= 32 > WINDOW-1, so the prefix always has
     # enough G-values to seed the scan halo.
     if n // SCAN_BLOCK >= 2 and rem % 32 == 0:
-        return _gear_bitmap_blocked(data, avg_bits, SCAN_BLOCK)
-    return pack_bits(boundary_mask(gear_hash(data), avg_bits))
+        return _gear_bitmap_blocked(data, avg_bits, SCAN_BLOCK,
+                                    halo_g=halo_g)
+    h = _windowed_sum(
+        jnp.concatenate([halo_g, _gear_value(data)],
+                        axis=-1))[..., WINDOW - 1:]
+    return pack_bits(boundary_mask(h, avg_bits))
 
 
 def select_boundaries_np(

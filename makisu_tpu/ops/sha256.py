@@ -201,24 +201,28 @@ def sha256_words(words: jax.Array, n_blocks: jax.Array,
     return jnp.transpose(state, (1, 0))
 
 
-@functools.partial(jax.jit, donate_argnums=())
-def sha256_lanes(data: jax.Array, lengths: jax.Array) -> jax.Array:
+def sha256_lanes_impl(data: jax.Array, lengths: jax.Array,
+                      init_state: jax.Array | None = None) -> jax.Array:
     """End-to-end: ragged uint8 lanes [L, CAP] + lengths [L] -> [L, 8] digests.
 
     Fused block-scan formulation: padding, byteswap, and the [L,64] ->
     [16,L] tile transpose all happen PER BLOCK inside the scan step, so
     the only full-size HBM traffic is one uint8 read of the lane buffer
     (~2 bytes/byte total). The pad_lanes + bytes_to_words + sha256_words
-    composition (kept for the sharded path and as the test reference)
-    materializes the whole buffer as uint32 words plus a transposed
-    copy — ~13 bytes of traffic per input byte."""
+    composition (kept as the test reference; the sharded path also uses
+    this fused impl, with a pcast IV) materializes the whole buffer as
+    uint32 words plus a transposed copy — ~13 bytes of traffic per
+    input byte."""
     L, cap = data.shape
     if cap % 64:
         raise ValueError(f"lane capacity {cap} not a multiple of 64")
     lengths = lengths.astype(jnp.int32)
     nb = num_blocks(lengths)
     total = nb * 64
-    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, L))
+    if init_state is None:
+        state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, L))
+    else:
+        state0 = init_state  # sharded path passes a pcast IV
 
     def step(state, b):
         blk = jax.lax.dynamic_slice_in_dim(data, b * 64, 64, axis=1)
@@ -232,6 +236,10 @@ def sha256_lanes(data: jax.Array, lengths: jax.Array) -> jax.Array:
     state, _ = jax.lax.scan(step, state0,
                             jnp.arange(cap // 64, dtype=jnp.int32))
     return jnp.transpose(state)
+
+
+sha256_lanes = functools.partial(jax.jit, donate_argnums=())(
+    sha256_lanes_impl)
 
 
 def digest_bytes(words: np.ndarray) -> list[bytes]:

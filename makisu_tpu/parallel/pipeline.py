@@ -3,7 +3,7 @@ ops/sha256.
 
 The long-stream dimension is genuinely sequence-parallel: Gear's hash at
 position i depends on at most the 31 previous bytes (mod 2^32 window), so
-a shard only needs a WINDOW-byte halo from its left neighbor —
+a shard only needs a 31-byte (WINDOW-1) halo from its left neighbor —
 one ``lax.ppermute`` over ICI per scan, the cheapest possible collective.
 This is the project's ring-attention analogue (SURVEY.md §5): where the
 reference hashes a layer as one sequential CPU stream
@@ -30,22 +30,25 @@ from makisu_tpu.ops import gear, sha256
 from makisu_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 
-def _gear_local(block: jax.Array, axis_name: str) -> jax.Array:
-    """Per-shard gear hashes with a left-neighbor halo over ``axis_name``.
-
-    block: uint8 [..., n_local]; returns uint32 [..., n_local].
+def _gear_bitmap_local(block: jax.Array, axis_name: str,
+                       avg_bits: int) -> jax.Array:
+    """Per-shard candidate bitmap with a left-neighbor halo over
+    ``axis_name``. One evaluation per shard: the neighbor's last 31
+    bytes arrive by ppermute, their G-VALUES seed the windowed sum
+    (masked to zero on shard 0, whose stream starts cold) — the same
+    halo mechanism the blocked scan uses between 64KiB blocks, so each
+    shard also gets the bandwidth-lean path when its local size allows.
     """
     n_shards = jax.lax.psum(1, axis_name)
-    halo = jax.lax.ppermute(
-        block[..., -gear.WINDOW:], axis_name,
+    halo_bytes = jax.lax.ppermute(
+        block[..., -(gear.WINDOW - 1):], axis_name,
         perm=[(i, (i + 1) % n_shards) for i in range(n_shards)])
-    ext = jnp.concatenate([halo, block], axis=-1)
-    h_with_halo = gear.gear_hash(ext)[..., gear.WINDOW:]
-    # Shard 0 has no left history: its hashes must treat the stream as
-    # starting at its first byte (zero history != zero-valued halo bytes).
-    h_start = gear.gear_hash(block)
+    halo_g = gear._gear_value(halo_bytes)
+    # Shard 0 has no left history: zero G-halo == the zero-history
+    # start convention (zero-valued halo BYTES would not be: G[0] != 0).
     is_first = jax.lax.axis_index(axis_name) == 0
-    return jnp.where(is_first, h_start, h_with_halo)
+    halo_g = jnp.where(is_first, jnp.uint32(0), halo_g)
+    return gear.gear_bitmap_with_halo(block, halo_g, avg_bits)
 
 
 def gear_bitmap_sharded(mesh: Mesh, avg_bits: int = gear.DEFAULT_AVG_BITS):
@@ -57,8 +60,7 @@ def gear_bitmap_sharded(mesh: Mesh, avg_bits: int = gear.DEFAULT_AVG_BITS):
         in_specs=P(DATA_AXIS, SEQ_AXIS),
         out_specs=P(DATA_AXIS, SEQ_AXIS))
     def _shard(block):
-        h = _gear_local(block, SEQ_AXIS)
-        return gear.pack_bits(gear.boundary_mask(h, avg_bits))
+        return _gear_bitmap_local(block, SEQ_AXIS, avg_bits)
 
     return jax.jit(_shard)
 
@@ -73,15 +75,14 @@ def sha256_lanes_sharded(mesh: Mesh):
         in_specs=(lanes_spec, vec_spec),
         out_specs=P((DATA_AXIS, SEQ_AXIS), None))
     def _shard(data, lengths):
-        msg = sha256.pad_lanes(data, lengths)
-        # The scan carry must be device-varying like the data (shard_map
-        # typing); mark the constant IV accordingly.
+        # Fused block-scan path (padding/packing inside the scan step),
+        # same as single-chip. The scan carry must be device-varying
+        # like the data (shard_map typing); mark the constant IV
+        # accordingly.
         state0 = jnp.broadcast_to(jnp.asarray(sha256._H0)[:, None],
                                   (8, data.shape[0]))
         state0 = jax.lax.pcast(state0, (DATA_AXIS, SEQ_AXIS), to="varying")
-        return sha256.sha256_words(sha256.bytes_to_words(msg),
-                                   sha256.num_blocks(lengths),
-                                   init_state=state0)
+        return sha256.sha256_lanes_impl(data, lengths, init_state=state0)
 
     return jax.jit(_shard)
 

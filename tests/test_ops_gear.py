@@ -87,3 +87,27 @@ def test_blocked_bitmap_matches_reference_on_production_shape():
     href = gear.gear_hash_ref(data.tobytes())
     want = np.asarray(gear.pack_bits((href & np.uint32(63)) == 0))
     np.testing.assert_array_equal(words, want)
+
+
+def test_halo_seeded_blocked_path_matches_full_stream():
+    """gear_bitmap_with_halo with a NONZERO halo routed into the
+    blocked scan (segment >= 2 SCAN_BLOCKs) must cut the same
+    boundaries as the unsharded full stream — the mesh shard sizes in
+    test_parallel are small enough to take the flat branch, so this
+    pins the branch they don't."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(37)
+    seg = 2 * gear.SCAN_BLOCK
+    whole = rng.integers(0, 256, size=2 * seg, dtype=np.uint8)
+    full = np.asarray(gear.gear_bitmap(whole, 6))
+    halo_g = gear._gear_value(jnp.asarray(whole[seg - 31:seg]))
+    second = np.asarray(gear.gear_bitmap_with_halo(
+        jnp.asarray(whole[seg:]), halo_g, 6))
+    np.testing.assert_array_equal(second, full[seg // 32:])
+    # And with a remainder on the segment (prefix branch + halo).
+    off = 64
+    halo_g2 = gear._gear_value(jnp.asarray(whole[seg - off - 31:seg - off]))
+    second2 = np.asarray(gear.gear_bitmap_with_halo(
+        jnp.asarray(whole[seg - off:]), halo_g2, 6))
+    np.testing.assert_array_equal(second2, full[(seg - off) // 32:])

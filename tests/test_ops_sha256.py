@@ -49,8 +49,9 @@ def test_known_vectors():
 
 def test_fused_lanes_match_reference_composition():
     """sha256_lanes (fused block-scan: padding/byteswap inside the
-    step) must stay digest-identical to the pad_lanes + bytes_to_words
-    + sha256_words composition the sharded path uses."""
+    step; also what the sharded path runs) must stay digest-identical
+    to the pad_lanes + bytes_to_words + sha256_words composition kept
+    as the reference."""
     rng = np.random.default_rng(31)
     L, cap = 32, 512
     data = rng.integers(0, 256, size=(L, cap), dtype=np.uint8)
