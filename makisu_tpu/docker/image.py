@@ -29,6 +29,13 @@ MEDIA_TYPE_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
 MEDIA_TYPE_OCI_CONFIG = "application/vnd.oci.image.config.v1+json"
 MEDIA_TYPE_OCI_LAYER = "application/vnd.oci.image.layer.v1.tar+gzip"
 
+# Multi-arch fan-out documents: resolved to a platform manifest on pull
+# (capability the reference LACKS — it errors on these; docker selects
+# the host platform, and so do we, default linux/amd64).
+MEDIA_TYPE_MANIFEST_LIST = \
+    "application/vnd.docker.distribution.manifest.list.v2+json"
+MEDIA_TYPE_OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+
 # sha256 of the empty gzipped tar; docker uses it for no-op layers.
 DIGEST_EMPTY_TAR = (
     "sha256:84ff92691f909a05b224e1c56abb4864f01b4f8e3c854e4bb4c7baf1d3f6d652"

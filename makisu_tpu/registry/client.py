@@ -18,7 +18,9 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from makisu_tpu.docker.image import (
+from makisu_tpu.docker.image import (  # noqa: F401 - re-export surface
+    MEDIA_TYPE_MANIFEST_LIST,
+    MEDIA_TYPE_OCI_INDEX,
     MEDIA_TYPE_CONFIG,
     MEDIA_TYPE_LAYER,
     MEDIA_TYPE_MANIFEST,
@@ -245,11 +247,13 @@ class RegistryClient:
             self.store.manifests.save(name, manifest)
         return manifest
 
-    def pull_manifest(self, tag: str) -> DistributionManifest:
+    def pull_manifest(self, tag: str,
+                      _depth: int = 0) -> DistributionManifest:
         resp = self._send(
             "GET", f"{self._base()}/manifests/{tag}",
-            headers={"Accept":
-                     f"{MEDIA_TYPE_MANIFEST}, {MEDIA_TYPE_OCI_MANIFEST}"})
+            headers={"Accept": ", ".join((
+                MEDIA_TYPE_MANIFEST, MEDIA_TYPE_OCI_MANIFEST,
+                MEDIA_TYPE_MANIFEST_LIST, MEDIA_TYPE_OCI_INDEX))})
         if tag.startswith("sha256:"):
             # Pull-by-digest (FROM image@sha256:...): the returned bytes
             # must hash to the requested digest or the registry lied.
@@ -258,7 +262,22 @@ class RegistryClient:
                 raise ValueError(
                     f"manifest digest mismatch: asked for {tag}, "
                     f"got {actual}")
-        manifest = DistributionManifest.from_bytes(resp.body)
+        parsed = json.loads(resp.body)
+        media_type = parsed.get("mediaType", "")
+        # Multi-arch index / manifest list (capability the reference
+        # lacks — it errors here): select the configured platform and
+        # re-pull that manifest BY DIGEST, so the child bytes are
+        # digest-verified. mediaType is optional for OCI indexes; the
+        # "manifests" fan-out key identifies them regardless.
+        if (media_type in (MEDIA_TYPE_MANIFEST_LIST, MEDIA_TYPE_OCI_INDEX)
+                or (not media_type and "manifests" in parsed
+                    and "config" not in parsed)):
+            if _depth >= 2:
+                raise ValueError(
+                    f"manifest index nesting too deep at {tag}")
+            digest = self._select_platform_manifest(parsed, tag)
+            return self.pull_manifest(digest, _depth=_depth + 1)
+        manifest = DistributionManifest.from_json(parsed)
         if manifest.schema_version != 2:
             raise ValueError(
                 f"unsupported manifest schema {manifest.schema_version} "
@@ -266,12 +285,55 @@ class RegistryClient:
         if manifest.media_type not in (MEDIA_TYPE_MANIFEST,
                                        MEDIA_TYPE_OCI_MANIFEST):
             raise ValueError(
-                f"unsupported manifest type {manifest.media_type!r} "
-                "(multi-arch indexes/manifest lists are not supported; "
-                "pull a platform-specific tag or digest)")
+                f"unsupported manifest type {manifest.media_type!r}")
         if manifest.config is None:
             raise ValueError("manifest has no config descriptor")
         return self._normalize_manifest(manifest)
+
+    def _select_platform_manifest(self, index: dict, tag: str) -> str:
+        """Pick the target platform's manifest digest from an index.
+
+        Platform = MAKISU_TPU_PLATFORM ("os/arch[/variant]", default
+        linux/amd64 — container images are overwhelmingly amd64-built
+        and this host-independent default keeps builds reproducible).
+        An exact variant match wins; otherwise the first os/arch match.
+        """
+        want = os.environ.get("MAKISU_TPU_PLATFORM", "linux/amd64")
+        parts = want.split("/")
+        want_os, want_arch = parts[0], parts[1] if len(parts) > 1 else ""
+        want_variant = parts[2] if len(parts) > 2 else ""
+        candidates = []
+        for entry in index.get("manifests") or []:
+            platform = entry.get("platform") or {}
+            if (platform.get("os") == want_os
+                    and platform.get("architecture") == want_arch):
+                candidates.append((platform.get("variant", ""), entry))
+        chosen = None
+        for variant, entry in candidates:
+            if variant == want_variant:
+                chosen = entry
+                break
+        if (chosen is None and candidates and not want_variant):
+            # os/arch requested without a variant: accept the index's
+            # sole variant (the common linux/arm64 → arm64/v8 case).
+            # An EXPLICIT variant never falls back — substituting v8
+            # binaries for a v6 request would only fail at runtime.
+            variants = {v for v, _ in candidates}
+            if len(variants) == 1:
+                chosen = candidates[0][1]
+        if chosen is None or not chosen.get("digest"):
+            available = sorted({
+                "/".join(filter(None, (
+                    (e.get("platform") or {}).get("os", "?"),
+                    (e.get("platform") or {}).get("architecture", "?"),
+                    (e.get("platform") or {}).get("variant", ""))))
+                for e in index.get("manifests") or []})
+            raise ValueError(
+                f"no manifest for platform {want!r} in index {tag} "
+                f"(available: {available}; set MAKISU_TPU_PLATFORM)")
+        log.info("resolved multi-arch index %s to %s (%s)", tag,
+                 chosen["digest"], want)
+        return chosen["digest"]
 
     @staticmethod
     def _normalize_manifest(

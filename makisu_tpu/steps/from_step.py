@@ -38,24 +38,63 @@ class FromStep(BuildStep):
         return self.image.lower() == "scratch"
 
     def set_cache_id(self, ctx: BuildContext, seed: str) -> None:
-        self.cache_id = chain_cache_id(seed, self.directive, self.image)
+        import os
+        # An explicit platform pin changes what a multi-arch tag
+        # resolves to, so it must be part of the cache identity — two
+        # platforms of one tag must never share layer-cache entries.
+        # Only chained when set: the unset default keeps pre-existing
+        # cache ids valid.
+        platform = os.environ.get("MAKISU_TPU_PLATFORM", "")
+        parts = [self.directive, self.image]
+        if platform:
+            parts.append(platform)
+        self.cache_id = chain_cache_id(seed, *parts)
+
+    @staticmethod
+    def _platform_matches(config: ImageConfig, want: str) -> bool:
+        parts = want.split("/")
+        want_os, want_arch = parts[0], parts[1] if len(parts) > 1 else ""
+        return config.os == want_os and config.architecture == want_arch
 
     def _load(self, ctx: BuildContext) -> None:
+        import os
         if self._manifest is not None:
             return
         name = ImageName.parse(self.image)
         store = ctx.image_store
+        want_platform = os.environ.get("MAKISU_TPU_PLATFORM", "")
+
+        def read_config(manifest) -> ImageConfig:
+            with store.layers.open(manifest.config.digest.hex()) as f:
+                return ImageConfig.from_bytes(f.read())
+
+        manifest = config = None
         if store.manifests.exists(name):
             manifest = store.manifests.load(name)
-        else:
+            config = read_config(manifest)
+            if want_platform and not self._platform_matches(
+                    config, want_platform):
+                # The locally cached manifest was resolved for another
+                # platform (multi-arch tag pulled before the pin
+                # changed): it must not be silently reused.
+                log.info("cached %s is %s/%s; re-pulling for %s",
+                         self.image, config.os, config.architecture,
+                         want_platform)
+                manifest = config = None
+        if manifest is None:
             if self.registry_client is None:
                 raise RuntimeError(
                     f"no registry client to pull base image {self.image}")
             manifest = self.registry_client.pull(name)
-        with store.layers.open(manifest.config.digest.hex()) as f:
-            config_blob = f.read()
+            config = read_config(manifest)
+            if want_platform and not self._platform_matches(
+                    config, want_platform):
+                raise ValueError(
+                    f"base image {self.image} is "
+                    f"{config.os}/{config.architecture}, but "
+                    f"MAKISU_TPU_PLATFORM wants {want_platform}")
         self._manifest = manifest
-        self._config = ImageConfig.from_bytes(config_blob)
+        self._config = config
         if len(self._config.rootfs.diff_ids) != len(manifest.layers):
             raise ValueError(
                 "base image layer count mismatch between config and manifest")

@@ -394,3 +394,61 @@ def test_history_has_empty_layer_entries(env):
     empty_entries = [h for h in cfg.history if h.empty_layer]
     assert len(layer_entries) == len(cfg.rootfs.diff_ids)
     assert empty_entries  # LABEL/CMD recorded as empty-layer history
+
+
+def test_from_platform_pin_repulls_and_isolates_cache(env, monkeypatch):
+    """MAKISU_TPU_PLATFORM participates in the FROM contract: a locally
+    cached manifest resolved for another platform is re-pulled, a
+    single-arch base that cannot satisfy the pin fails loudly, and the
+    FROM cache id differs per platform so layer caches never collide."""
+    from makisu_tpu.steps.from_step import FromStep
+
+    # Serve an amd64 base and pull it under the plain tag (as an
+    # earlier un-pinned build would have).
+    manifest = env.serve_base()
+    ctx = BuildContext(str(env.root), str(env.ctx_dir), env.store,
+                       sync_wait=0.0)
+    name = ImageName.parse("registry.test/library/base:latest")
+
+    class Puller:
+        def __init__(self):
+            self.pulls = 0
+
+        def pull(self, name):
+            self.pulls += 1
+            client = RegistryClient(env.store, name.registry,
+                                    name.repository,
+                                    transport=env.fixture)
+            return client.pull(name)
+
+    puller = Puller()
+    puller.pull(name)  # un-pinned earlier build: amd64 landed locally
+
+    step = FromStep("registry.test/library/base:latest",
+                    "registry.test/library/base:latest", alias="0")
+    step.registry_client = puller
+    # The cached config is amd64 (make_test_image default); pinning
+    # arm64 must re-pull, and the single-arch base then fails loudly.
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/arm64")
+    with pytest.raises(ValueError, match="linux/arm64"):
+        step._load(ctx)
+    assert puller.pulls == 2  # the stale local manifest was NOT trusted
+    # Matching pin: cached manifest is reused, no pull.
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/amd64")
+    step2 = FromStep("registry.test/library/base:latest",
+                     "registry.test/library/base:latest", alias="0")
+    step2.registry_client = puller
+    step2._load(ctx)
+    assert puller.pulls == 2
+
+    # Cache ids: unset == historical id; set pins get distinct ids.
+    ids = {}
+    for pin in (None, "linux/amd64", "linux/arm64"):
+        if pin is None:
+            monkeypatch.delenv("MAKISU_TPU_PLATFORM", raising=False)
+        else:
+            monkeypatch.setenv("MAKISU_TPU_PLATFORM", pin)
+        s = FromStep("x", "registry.test/library/base:latest", alias="0")
+        s.set_cache_id(ctx, "seed")
+        ids[pin] = s.cache_id
+    assert len(set(ids.values())) == 3

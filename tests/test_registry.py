@@ -261,14 +261,86 @@ def test_pull_302_relative_redirect(store, fixture):
         assert f.read() == blobs[layer_hex]
 
 
-def test_pull_manifest_rejects_index(store, fixture):
+def _serve_index(fixture, platforms, media_type=
+                 "application/vnd.oci.image.index.v1+json"):
+    """Serve per-platform images + an index fanning out to them.
+    Returns {os/arch[/variant]: (manifest, manifest_digest_hex)}."""
+    import hashlib as hl
     import json as json_mod
-    index = {"schemaVersion": 2,
-             "mediaType": "application/vnd.oci.image.index.v1+json",
-             "manifests": []}
+    entries = []
+    by_platform = {}
+    for i, plat in enumerate(platforms):
+        parts = plat.split("/")
+        manifest, _cfg, blobs = make_test_image(
+            files={f"etc/{plat}".replace("/", "-"): plat.encode()})
+        raw = manifest.to_bytes()
+        digest_hex = hl.sha256(raw).hexdigest()
+        fixture.manifests[f"team/app:sha256:{digest_hex}"] = raw
+        fixture.blobs.update(blobs)
+        platform = {"os": parts[0], "architecture": parts[1]}
+        if len(parts) > 2:
+            platform["variant"] = parts[2]
+        entries.append({
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "size": len(raw), "digest": f"sha256:{digest_hex}",
+            "platform": platform})
+        by_platform[plat] = (manifest, digest_hex)
+    index = {"schemaVersion": 2, "manifests": entries}
+    if media_type:
+        index["mediaType"] = media_type
     fixture.manifests["team/app:multi"] = json_mod.dumps(index).encode()
-    with pytest.raises(ValueError, match="multi-arch"):
+    return by_platform
+
+
+def test_pull_manifest_resolves_index_to_default_platform(store, fixture):
+    """Multi-arch indexes resolve to linux/amd64 by default — a
+    capability the reference lacks (it errors on indexes)."""
+    by_platform = _serve_index(
+        fixture, ["linux/arm64/v8", "linux/amd64", "windows/amd64"])
+    pulled = client(store, fixture).pull_manifest("multi")
+    want, _ = by_platform["linux/amd64"]
+    assert pulled.config.digest == want.config.digest
+    assert pulled.layer_digests() == want.layer_digests()
+
+
+def test_pull_manifest_index_platform_override(store, fixture, monkeypatch):
+    by_platform = _serve_index(
+        fixture, ["linux/arm64/v8", "linux/amd64"],
+        media_type="application/vnd.docker.distribution.manifest.list.v2+json")
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/arm64/v8")
+    pulled = client(store, fixture).pull_manifest("multi")
+    want, _ = by_platform["linux/arm64/v8"]
+    assert pulled.config.digest == want.config.digest
+
+
+def test_pull_manifest_index_missing_platform_lists_available(
+        store, fixture, monkeypatch):
+    _serve_index(fixture, ["linux/arm64/v8"])
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/s390x")
+    with pytest.raises(ValueError, match="linux/arm64/v8"):
         client(store, fixture).pull_manifest("multi")
+
+
+def test_pull_manifest_index_tampered_child_refused(store, fixture):
+    """The index's child manifest is fetched BY DIGEST, so a registry
+    serving different bytes under that digest is caught."""
+    import json as json_mod
+    by_platform = _serve_index(fixture, ["linux/amd64"])
+    _, digest_hex = by_platform["linux/amd64"]
+    raw = fixture.manifests[f"team/app:sha256:{digest_hex}"]
+    fixture.manifests[f"team/app:sha256:{digest_hex}"] = raw + b"\n"
+    with pytest.raises(ValueError, match="digest mismatch"):
+        client(store, fixture).pull_manifest("multi")
+
+
+def test_pull_image_through_index_end_to_end(store, fixture):
+    """cli pull of a multi-arch tag: index -> platform manifest ->
+    config + layers all land digest-verified."""
+    _serve_index(fixture, ["linux/amd64", "linux/arm64"])
+    pulled = client(store, fixture).pull(
+        ImageName("registry.test", "team/app", "multi"))
+    for desc in [pulled.config] + list(pulled.layers):
+        assert store.layers.exists(desc.digest.hex())
 
 
 def test_pull_manifest_rejects_zstd_layers(store, fixture):
@@ -320,3 +392,16 @@ def test_blob_redirect_loop_bounded(store, fixture):
     c = client(store, fixture)
     with pytest.raises(ValueError, match="redirect hops"):
         c.pull_layer(manifest.layers[0].digest)
+
+
+def test_pull_manifest_index_variant_semantics(store, fixture, monkeypatch):
+    """Bare os/arch accepts the index's sole variant (linux/arm64 →
+    arm64/v8); an EXPLICIT variant never silently substitutes."""
+    by_platform = _serve_index(fixture, ["linux/arm64/v8", "linux/amd64"])
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/arm64")
+    pulled = client(store, fixture).pull_manifest("multi")
+    want, _ = by_platform["linux/arm64/v8"]
+    assert pulled.config.digest == want.config.digest
+    monkeypatch.setenv("MAKISU_TPU_PLATFORM", "linux/arm64/v6")
+    with pytest.raises(ValueError, match="linux/arm64/v8"):
+        client(store, fixture).pull_manifest("multi")
