@@ -259,27 +259,36 @@ def main() -> int:
         # default-config measurement so rounds compare like for like.
         sweep_timeout = float(os.environ.get(
             "MAKISU_BENCH_SWEEP_TIMEOUT", str(tpu_timeout)))
-        sweep: dict = {}
-        best = None
-        for unroll in ("8", "16"):
-            alt, alt_err = _run_child(
-                {"MAKISU_TPU_SHA_UNROLL": unroll}, sweep_timeout)
-            if "gbps" not in alt:
-                sweep[unroll] = (
-                    f"error: stage={alt.get('stage_reached', 'none')}"
-                    f" ({alt_err[:120]})")
-            elif alt.get("backend") != result.get("backend"):
-                # Fell back to another backend (flaky tunnel): the
-                # number is not comparable — record that, not it.
-                sweep[unroll] = f"backend {alt.get('backend')}: n/a"
-            else:
-                sweep[unroll] = round(alt["gbps"], 3)
-                if alt["gbps"] > result["gbps"] and (
-                        best is None or alt["gbps"] > sweep.get(best, 0)):
-                    best = unroll
-        result["sha_unroll_sweep"] = sweep
-        if best is not None:
-            result["best_sha_unroll"] = int(best)
+
+        def sweep_children(env_key: str, values: tuple[str, ...]) -> dict:
+            """One child per knob value; records GB/s or a stage-tagged
+            error per value, plus the best value that beat the default."""
+            sweep: dict = {}
+            best = None
+            for value in values:
+                alt, alt_err = _run_child({env_key: value}, sweep_timeout)
+                if "gbps" not in alt:
+                    sweep[value] = (
+                        f"error: stage={alt.get('stage_reached', 'none')}"
+                        f" ({alt_err[:120]})")
+                elif alt.get("backend") != result.get("backend"):
+                    # Fell back to another backend (flaky tunnel): the
+                    # number is not comparable — record that, not it.
+                    sweep[value] = f"backend {alt.get('backend')}: n/a"
+                else:
+                    sweep[value] = round(alt["gbps"], 3)
+                    if alt["gbps"] > result["gbps"] and (
+                            best is None
+                            or alt["gbps"] > sweep.get(best, 0)):
+                        best = value
+            if best is not None:
+                sweep["best"] = best
+            return sweep
+
+        result["sha_unroll_sweep"] = sweep_children(
+            "MAKISU_TPU_SHA_UNROLL", ("8", "16"))
+        result["gear_scan_block_sweep"] = sweep_children(
+            "MAKISU_TPU_GEAR_SCAN_BLOCK", ("131072", "262144"))
 
     # Headline value: the big-shape number if it was measured, else the
     # tiny-shape device number (better a small-shape device datapoint
@@ -302,8 +311,9 @@ def main() -> int:
         record["value_source"] = source
     for extra in ("tiny_gbps", "init_secs", "compile_secs",
                   "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
-                  "pallas_error", "sha_unroll_sweep", "best_sha_unroll",
-                  "device_attempt", "jax_platforms_env", "device_kind"):
+                  "pallas_error", "sha_unroll_sweep",
+                  "gear_scan_block_sweep", "device_attempt",
+                  "jax_platforms_env", "device_kind"):
         if extra in result:
             record[extra] = result[extra]
     if errors:
