@@ -146,6 +146,10 @@ def unpack_bits_np(words: np.ndarray, n: int) -> np.ndarray:
 # block size.
 SCAN_BLOCK = int(_os.environ.get("MAKISU_TPU_GEAR_SCAN_BLOCK",
                                  str(64 * 1024)))
+if SCAN_BLOCK <= 0 or SCAN_BLOCK % 32:
+    raise ValueError(
+        f"MAKISU_TPU_GEAR_SCAN_BLOCK={SCAN_BLOCK} must be a positive "
+        "multiple of 32 (pack_bits works in 32-bit words)")
 
 
 def _gear_bitmap_blocked(data: jax.Array, avg_bits: int, block: int,

@@ -282,3 +282,32 @@ def test_zlib0_layer_sink_and_reconstitution(tmp_path):
         [(c.offset, c.length, c.hex_digest) for c in commit.chunks],
         gz_backend="zlib-0")
     assert rebuilt == blob
+
+
+def test_zlib0_rebuffer_fuzz_random_write_chunking():
+    """Property: for zlib-0, ANY write chunking yields the same bytes
+    as a single whole-stream write (the fixed-granularity rebuffer is
+    what cache identity rests on for --compression no)."""
+    import io
+    import random
+
+    from makisu_tpu import tario
+    payload = rand_bytes(700_000, 77)
+    # Reference: ONE whole-stream write (what reconstitution does).
+    ref = io.BytesIO()
+    gz = tario.gzip_writer(ref, backend_id="zlib-0")
+    gz.write(payload)
+    gz.close()
+    want = ref.getvalue()
+    rnd = random.Random(7)
+    for trial in range(6):
+        out = io.BytesIO()
+        gz = tario.gzip_writer(out, backend_id="zlib-0")
+        pos = 0
+        while pos < len(payload):
+            step = rnd.choice((1, 37, 511, 4096, 65535, 65536, 200_000))
+            gz.write(payload[pos:pos + step])
+            pos += step
+        gz.close()
+        got = out.getvalue()
+        assert got == want, f"trial {trial} diverged"

@@ -286,3 +286,29 @@ def test_timeout_mid_reply_never_desyncs(mini):
     assert store.get("b") == "value-b"
     assert store.get("a") == "value-a"
     store.close()
+
+
+def test_malformed_reply_discards_connection(mini):
+    """Garbage framing from the server must raise (and discard the
+    connection), never hang or be silently misparsed."""
+    store = RedisStore(mini.addr, ttl_seconds=60)
+    store.put("k", "v")
+    # Inject a raw garbage reply by speaking to the store's socket
+    # buffer directly: simulate by pointing the connection at a server
+    # that answers with a non-RESP line.
+    rogue = socket.create_server(("127.0.0.1", 0))
+
+    def answer_garbage():
+        conn, _ = rogue.accept()
+        conn.recv(65536)
+        conn.sendall(b"NOT RESP AT ALL\r\n")
+        conn.close()
+
+    threading.Thread(target=answer_garbage, daemon=True).start()
+    from makisu_tpu.cache.kv import _RespConnection
+    conn = _RespConnection("127.0.0.1", rogue.getsockname()[1])
+    with pytest.raises((ConnectionError, OSError)):
+        conn.command("GET", "k")
+    assert conn._sock is None  # discarded, not reused
+    rogue.close()
+    store.close()
