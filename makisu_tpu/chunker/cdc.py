@@ -16,6 +16,13 @@ blocks and produces content-defined chunks with SHA-256 fingerprints:
 Everything dispatches asynchronously; device→host syncs happen only for
 bitmap readback and at ``finish()``.
 
+Failure discipline: chunk fingerprints are an OPTIMIZATION (they enable
+chunk-granular cache dedup); the layer's registry identity comes from
+the CPU digests. So a device failure mid-stream (backend died, tunnel
+dropped, OOM) degrades the session — the layer commits with an empty
+chunk list and whole-layer caching only — instead of failing the build.
+``MAKISU_TPU_CHUNK_STRICT=1`` re-raises instead (tests/debugging).
+
 This is the long-stream scaling design the reference lacks (its hashing is
 a single sequential SHA-256 stream, lib/builder/step/common.go:35-67); see
 SURVEY.md §5 "long-context" mapping.
@@ -127,32 +134,87 @@ class ChunkSession:
         self._batchers = [_LaneBatcher(cap, lanes)
                           for cap, lanes in _BUCKETS]
         self._chunks: list[Chunk] = []
+        self._degraded: str | None = None  # failure summary once degraded
+
+    # -- failure discipline ----------------------------------------------
+
+    def _degrade(self, stage: str, exc: Exception) -> None:
+        """Device failure: drop chunk tracking for this layer and let
+        the build continue (whole-layer caching only). Never corrupts —
+        a degraded layer simply has no fingerprints, and the regular
+        chunk-dedup tests would fail if this path ever triggered on a
+        healthy device."""
+        import os
+
+        from makisu_tpu.utils import logging as log
+        if os.environ.get("MAKISU_TPU_CHUNK_STRICT") == "1":
+            raise exc
+        log.warning(
+            "chunk fingerprinting disabled for this layer (%s: %s); "
+            "build continues with whole-layer caching only", stage, exc)
+        # Summary string, NOT the exception: its traceback would pin
+        # the failing frames (4MiB blocks, numpy buffers) that the
+        # clears below exist to release.
+        self._degraded = f"{stage}: {exc}"
+        self._staging.clear()
+        self._tail.clear()
+        self._inflight = []
+        self._chunks = []
+        self._service_pending = []
+        for b in self._batchers:
+            b.meta = []
+            b.pending = []
 
     # -- byte intake ------------------------------------------------------
 
     def update(self, data: bytes) -> None:
+        if self._degraded is not None:
+            return
         self._staging.extend(data)
         while len(self._staging) >= self.block:
             blk = bytes(self._staging[:self.block])
             del self._staging[:self.block]
-            self._dispatch_block(blk)
+            try:
+                # (the dispatch also drains the oldest in-flight block
+                # when the pipeline is full, so readback errors can
+                # surface here too — hence the broader stage label)
+                self._dispatch_block(blk)
+            except Exception as e:  # noqa: BLE001 - device plane
+                self._degrade("gear pipeline", e)
+                return
 
     def finish(self) -> list[Chunk]:
-        if self._staging:
+        if self._degraded is None and self._staging:
             blk = bytes(self._staging)
             pad = (-len(blk)) % 32
-            self._dispatch_block(blk + b"\x00" * pad, live=len(blk))
+            try:
+                self._dispatch_block(blk + b"\x00" * pad, live=len(blk))
+            except Exception as e:  # noqa: BLE001 - device plane
+                self._degrade("gear pipeline", e)
             self._staging.clear()
-        while self._inflight:
-            self._process_block(self._inflight.pop(0))
+        while self._degraded is None and self._inflight:
+            try:
+                self._process_block(self._inflight.pop(0))
+            except Exception as e:  # noqa: BLE001 - device plane
+                self._degrade("gear readback", e)
         # Final chunk: whatever follows the last cut.
-        if self._tail:
-            self._emit(bytes(self._tail), self._tail_offset)
+        if self._degraded is None and self._tail:
+            try:
+                self._emit(bytes(self._tail), self._tail_offset)
+            except Exception as e:  # noqa: BLE001 - device plane
+                self._degrade("lane dispatch", e)
             self._tail.clear()
-        for b in self._batchers:
-            self._chunks.extend(b.drain())
-        for offset, length, fut in self._service_pending:
-            self._chunks.append(Chunk(offset, length, fut.result()))
+        if self._degraded is None:
+            try:
+                for b in self._batchers:
+                    self._chunks.extend(b.drain())
+                for offset, length, fut in self._service_pending:
+                    self._chunks.append(
+                        Chunk(offset, length, fut.result()))
+            except Exception as e:  # noqa: BLE001 - device plane
+                self._degrade("lane hashing", e)
+        if self._degraded is not None:
+            return []
         self._service_pending = []
         self._chunks.sort(key=lambda c: c.offset)
         return self._chunks

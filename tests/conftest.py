@@ -28,6 +28,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# Chunk fingerprinting degrades (not fails) on device errors in
+# production; in tests a device error is a BUG — fail loudly. The
+# degradation tests opt out per-test.
+os.environ.setdefault("MAKISU_TPU_CHUNK_STRICT", "1")
 
 
 import pytest  # noqa: E402
