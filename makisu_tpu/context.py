@@ -53,6 +53,32 @@ class BuildContext:
         self.blacklist = blacklist + [context_dir, image_store.root]
         kwargs = {} if sync_wait is None else {"sync_wait": sync_wait}
         self.memfs = MemFS(root_dir, self.blacklist, **kwargs)
+        # .dockerignore (capability beyond the reference): the excluded
+        # path set is computed lazily on first context COPY/ADD and
+        # cached for the build.
+        self._ignore_excluded: list[str] | None = None
+        self._ignore_prefixes = None  # PrefixSet over _ignore_excluded
+
+    def context_excluded_paths(self) -> list[str]:
+        """Absolute context paths excluded by .dockerignore (empty when
+        the file is absent)."""
+        if self._ignore_excluded is None:
+            from makisu_tpu.utils.dockerignore import DockerIgnore, PrefixSet
+            ignore = DockerIgnore.load(self.context_dir)
+            self._ignore_excluded = (
+                ignore.excluded_paths(self.context_dir) if ignore else [])
+            self._ignore_prefixes = PrefixSet(self._ignore_excluded)
+            if self._ignore_excluded:
+                from makisu_tpu.utils import logging as log
+                log.info(".dockerignore excludes %d context paths",
+                         len(self._ignore_excluded))
+        return self._ignore_excluded
+
+    def context_path_ignored(self, path: str) -> bool:
+        """O(log n) .dockerignore probe (the checksum/copy walks call
+        this once per context path)."""
+        self.context_excluded_paths()
+        return self._ignore_prefixes.covers(path)
 
     def copy_from_root(self, alias: str) -> str:
         """Sandbox dir holding stage ``alias``'s checkpointed files for
@@ -79,4 +105,6 @@ class BuildContext:
         ctx.blacklist = self.blacklist
         ctx.memfs = MemFS(self.root_dir, self.blacklist,
                           sync_wait=self.memfs.sync_wait)
+        ctx._ignore_excluded = self._ignore_excluded
+        ctx._ignore_prefixes = self._ignore_prefixes
         return ctx

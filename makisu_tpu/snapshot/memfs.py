@@ -297,7 +297,11 @@ class MemFS:
                 self._maybe_add(layer, cur, pathutils.abs_path(cur_dst), hdr,
                                 create_whiteouts=False)
 
-            walk(src, None, visit)
+            # Same blacklist policy as the on-disk Copier (copy_op.py
+            # _copier): external copies prune blacklisted sources —
+            # incl. .dockerignore exclusions — internal (--from) copies
+            # see everything in their sandbox.
+            walk(src, None if op.internal else op.blacklist, visit)
 
     # ------------------------------------------------------------------
     # Tar merging / untarring

@@ -48,12 +48,26 @@ class AddCopyStep(BuildStep):
         return ctx.context_dir
 
     def _resolve_sources(self, ctx: BuildContext) -> list[str]:
-        """Glob-expand sources against the source root (absolute paths)."""
+        """Glob-expand sources against the source root (absolute paths).
+        Context sources matching .dockerignore are invisible — the same
+        "never entered the context" semantics docker gives them."""
         root = self._source_root(ctx)
+        check_ignore = not self.from_stage
         out: list[str] = []
         for src in self.srcs:
             pattern = os.path.join(root, pathutils.rel_path(src))
             matches = glob(pattern)
+            if check_ignore:
+                visible = [m for m in matches
+                           if not ctx.context_path_ignored(m)]
+                if matches and not visible:
+                    # Everything the pattern named is dockerignored:
+                    # fail like docker does, not with an empty copy or
+                    # an unexpanded-pattern stat error downstream.
+                    raise ValueError(
+                        f"COPY/ADD source {src!r}: all matches are "
+                        "excluded by .dockerignore")
+                matches = visible
             out.extend(sorted(matches) if matches else [pattern])
         return out
 
@@ -71,6 +85,10 @@ class AddCopyStep(BuildStep):
     def _checksum_tree(self, ctx: BuildContext, path: str,
                        checksum: int) -> int:
         if not os.path.lexists(path):
+            return checksum
+        if ctx.context_path_ignored(path):
+            # Ignored files must not influence cache identity either —
+            # editing them cannot change the build's output.
             return checksum
         st = os.lstat(path)
         if sysutils.is_special_file(st):
@@ -96,6 +114,10 @@ class AddCopyStep(BuildStep):
         rel_paths = [pathutils.trim_root(s, source_root)
                      for s in self._resolve_sources(ctx)]
         blacklist = list(ctx.base_blacklist) + [ctx.image_store.root]
+        if not self.from_stage:
+            # .dockerignore exclusions ride the blacklist, which both
+            # the on-disk Copier and the MemFS copy-op diff honor.
+            blacklist += ctx.context_excluded_paths()
         op = CopyOperation(
             rel_paths, source_root, self.logical_working_dir, self.dst,
             chown=self.chown, blacklist=blacklist,

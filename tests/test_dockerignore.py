@@ -1,0 +1,201 @@
+""".dockerignore support: docker-semantics pattern matching + build
+integration (capability beyond the reference, which only has
+--blacklist)."""
+
+import os
+
+import pytest
+
+from makisu_tpu.utils.dockerignore import DockerIgnore
+
+# Bring in the integration harness from the contexts suite.
+from tests.test_integration_contexts import Env  # noqa: F401
+
+
+@pytest.fixture
+def env(tmp_path):
+    return Env(tmp_path)
+
+
+def ign(*lines):
+    return DockerIgnore(list(lines))
+
+
+def test_basic_patterns():
+    d = ign("*.log", "temp")
+    assert d.excluded("build.log")
+    assert d.excluded("temp")
+    assert d.excluded("temp/inner.txt")     # dir match covers children
+    assert not d.excluded("src/main.py")
+    assert not d.excluded("sub/deep.log")   # * stays in one segment
+
+
+def test_double_star_crosses_segments():
+    d = ign("**/*.log", "docs/**")
+    assert d.excluded("a.log")
+    assert d.excluded("x/y/z/a.log")
+    assert d.excluded("docs/guide.md")
+    assert d.excluded("docs/a/b/c.md")
+    assert not d.excluded("docs")           # a/** excludes contents, not a
+    assert not d.excluded("src/a.txt")
+
+
+def test_negation_last_match_wins():
+    d = ign("node_modules", "!node_modules/keep.txt")
+    assert d.excluded("node_modules")
+    assert d.excluded("node_modules/junk.js")
+    assert not d.excluded("node_modules/keep.txt")
+    # Re-exclusion after re-inclusion.
+    d2 = ign("*.md", "!README.md", "README.md")
+    assert d2.excluded("README.md")
+
+
+def test_comments_blanks_and_anchoring():
+    d = ign("# a comment", "", "/rooted.txt", "dir/")
+    assert d.excluded("rooted.txt")
+    assert d.excluded("dir")
+    assert d.excluded("dir/file")
+    assert not d.excluded("sub/rooted.txt")
+
+
+def test_question_mark_and_class():
+    d = ign("file?.txt", "data[0-9].bin")
+    assert d.excluded("file1.txt")
+    assert not d.excluded("file12.txt")
+    assert d.excluded("data7.bin")
+    assert not d.excluded("dataX.bin")
+
+
+def test_excluded_paths_minimal_set(tmp_path):
+    root = tmp_path / "ctx"
+    (root / "node_modules" / "pkg").mkdir(parents=True)
+    (root / "node_modules" / "pkg" / "a.js").write_text("x")
+    (root / "src").mkdir()
+    (root / "src" / "main.py").write_text("x")
+    (root / "debug.log").write_text("x")
+    d = ign("node_modules", "*.log")
+    out = d.excluded_paths(str(root))
+    assert str(root / "node_modules") in out     # pruned whole
+    assert str(root / "debug.log") in out
+    assert len(out) == 2
+
+
+def test_excluded_paths_with_negation_descends(tmp_path):
+    root = tmp_path / "ctx"
+    (root / "vendor").mkdir(parents=True)
+    (root / "vendor" / "junk.js").write_text("x")
+    (root / "vendor" / "keep.txt").write_text("x")
+    d = ign("vendor", "!vendor/keep.txt")
+    out = d.excluded_paths(str(root))
+    assert str(root / "vendor" / "junk.js") in out
+    assert str(root / "vendor") not in out       # keep.txt survives
+    assert str(root / "vendor" / "keep.txt") not in out
+
+
+def test_build_honors_dockerignore(env):
+    """COPY . with a .dockerignore: ignored files are invisible to the
+    layer, present files copy normally."""
+    env.file(".dockerignore", "*.log\nnode_modules\n!important.log\n")
+    env.file("app.py", "code")
+    env.file("debug.log", "noise")
+    env.file("important.log", "keep me")
+    env.file("node_modules/dep/index.js", "dep")
+    m = env.build("FROM scratch\nCOPY . /app/\n")
+    members = env.layers(m)
+    assert "app/app.py" in members
+    assert "app/important.log" in members
+    assert "app/debug.log" not in members
+    assert not any(n.startswith("app/node_modules") for n in members)
+    # The context's own .dockerignore file copies (docker parity: it is
+    # part of the context unless ignored).
+    assert "app/.dockerignore" in members
+
+
+def test_dockerignore_glob_sources_filtered(env):
+    env.file(".dockerignore", "secret*.txt\n")
+    env.file("a.txt", "a")
+    env.file("secret1.txt", "s")
+    m = env.build("FROM scratch\nCOPY *.txt /texts/\n")
+    members = env.layers(m)
+    assert "texts/a.txt" in members
+    assert "texts/secret1.txt" not in members
+
+
+def test_dockerignore_cache_id_ignores_excluded_files(env, tmp_path):
+    """Editing an ignored file must not change the COPY cache id."""
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.steps.add_copy import CopyStep
+
+    env.file(".dockerignore", "*.log\n")
+    env.file("app.py", "code")
+    log_file = env.file("debug.log", "v1")
+
+    def cache_id():
+        ctx = BuildContext(str(env.root), str(env.ctx_dir), env.store,
+                           sync_wait=0.0)
+        step = CopyStep("", "", "", ["."], "/app/", commit=False,
+                        preserve_owner=False)
+        step.logical_working_dir = "/"
+        step.set_cache_id(ctx, "seed")
+        return step.cache_id
+
+    first = cache_id()
+    log_file.write_text("v2 - changed")
+    assert cache_id() == first          # ignored file: no invalidation
+    env.file("app.py", "code changed")
+    assert cache_id() != first          # real file: invalidates
+
+
+def test_dockerignore_modifyfs_build(env):
+    """The on-disk Copier honors the same exclusions (modifyfs path)."""
+    env.file(".dockerignore", "*.secret\n")
+    env.file("keep.txt", "k")
+    env.file("topsecret.secret", "s")
+    m = env.build("FROM scratch\nCOPY . /app/\n"
+                  "RUN test -f app/keep.txt && test ! -e app/topsecret.secret\n",
+                  modify_fs=True)
+    members = env.layers(m)
+    assert "app/keep.txt" in members
+    assert "app/topsecret.secret" not in members
+
+
+def test_all_matches_ignored_fails_like_docker(env):
+    env.file(".dockerignore", "secret.txt\n*.log\n")
+    env.file("secret.txt", "s")
+    env.file("a.log", "l")
+    env.file("ok.txt", "k")
+    with pytest.raises(ValueError, match="excluded by .dockerignore"):
+        env.build("FROM scratch\nCOPY secret.txt /x/\n")
+    with pytest.raises(ValueError, match="excluded by .dockerignore"):
+        env.build("FROM scratch\nCOPY *.log /x/\n")
+    # A pattern with surviving matches still works.
+    m = env.build("FROM scratch\nCOPY *.txt /x/\n")
+    members = env.layers(m)
+    assert "x/ok.txt" in members and "x/secret.txt" not in members
+
+
+def test_reincluded_symlink_and_empty_dir_survive(tmp_path):
+    root = tmp_path / "ctx"
+    (root / "vendor" / "sub").mkdir(parents=True)
+    (root / "vendor" / "junk.js").write_text("x")
+    (root / "vendor" / "emptykeep").mkdir()
+    os.symlink("sub", root / "vendor" / "link")
+    d = ign("vendor", "!vendor/emptykeep", "!vendor/link")
+    out = d.excluded_paths(str(root))
+    assert str(root / "vendor") not in out          # not pruned whole
+    assert str(root / "vendor" / "junk.js") in out
+    assert str(root / "vendor" / "sub") in out      # still excluded
+    assert str(root / "vendor" / "emptykeep") not in out
+    assert str(root / "vendor" / "link") not in out
+
+
+def test_prefix_set_covers():
+    from makisu_tpu.utils.dockerignore import PrefixSet
+    ps = PrefixSet(["/ctx/node_modules", "/ctx/debug.log"])
+    assert ps.covers("/ctx/node_modules")
+    assert ps.covers("/ctx/node_modules/deep/a.js")
+    assert ps.covers("/ctx/debug.log")
+    assert not ps.covers("/ctx/node_modules2")      # sibling, not child
+    assert not ps.covers("/ctx/debug.log2")
+    assert not ps.covers("/ctx")
+    assert not PrefixSet([]).covers("/anything")
