@@ -71,10 +71,16 @@ def _gear_kernel(avg_bits: int, rows_ref, out_ref) -> None:
         m *= 2
     live = h[:, HALO:]                                # [T, ROW]
     mask = (live & jnp.uint32((1 << avg_bits) - 1)) == 0
-    b = mask.reshape(mask.shape[0], ROW // 32, 32).astype(jnp.uint32)
-    weights = jnp.uint32(1) << jax.lax.broadcasted_iota(
-        jnp.uint32, (1, 1, 32), 2)
-    out_ref[:] = jnp.sum(b * weights, axis=-1, dtype=jnp.uint32)
+    # Bit-pack via an int32 reduction: Mosaic (TPU Pallas) rejects
+    # reductions over unsigned ints ("Reductions over unsigned integers
+    # not implemented", observed on a real v5e), and two's-complement
+    # wrap makes the int32 weighted sum bit-identical to the uint32 one
+    # (bit 31's weight is INT32_MIN; the sum wraps mod 2^32).
+    b = mask.reshape(mask.shape[0], ROW // 32, 32).astype(jnp.int32)
+    weights = jnp.int32(1) << jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, 32), 2)
+    packed = jnp.sum(b * weights, axis=-1, dtype=jnp.int32)
+    out_ref[:] = jax.lax.bitcast_convert_type(packed, jnp.uint32)
 
 
 @functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))

@@ -126,50 +126,85 @@ def bytes_to_words(msg: jax.Array) -> jax.Array:
     )
 
 
-# How many rounds each scan iteration unrolls. SHA-256's 64 rounds are a
-# strict dependency chain, so unrolling buys instruction-level fusion, not
-# parallelism — but a fully unrolled body (64 rounds x ~30 uint32 ops, plus
-# the message schedule) produces an HLO graph XLA takes minutes to compile
-# on a small host. A rolled lax.scan with modest unroll compiles in seconds
-# and runs the same VPU work per round. Tunable per deployment
-# (MAKISU_TPU_SHA_UNROLL) — on real TPU toolchains higher unrolls trade
-# compile time for lower loop overhead.
-ROUND_UNROLL = int(_os.environ.get("MAKISU_TPU_SHA_UNROLL", "4"))
+# Note on history: the first formulation ran the 64 rounds as a
+# lax.scan (tunable via a MAKISU_TPU_SHA_UNROLL knob, now retired)
+# whose carry stacked the state ([8, L]) and shifted the 16-word message
+# schedule ([16, L]) with a concatenate EVERY round — ~256KB of pure
+# relayout copies per round per 4096 lanes, measured 1.5 GB/s on a real
+# v5e. The SSA formulation below keeps every word in its own loop-carried
+# variable (the schedule window rotates by variable renaming: zero
+# copies, no gather, static round indices) with HLO size bounded by
+# peeling rounds 0-15 and scanning 3 groups of 16 schedule rounds — a
+# 16-round group rotates the window exactly once, so the scan carry maps
+# positionally.
+
+# Unroll factors for the two scans, swept on a real v5e (2026-07, this
+# repo's device session): the inner 16-round-group scan and the outer
+# block scan. Measured on 4096x16KiB lanes, device-side loop timing:
+#   inner=1 outer=1:  8.8 GB/s     inner=3 outer=1: 21.9 GB/s
+#   inner=1 outer=2:  8.3 GB/s     inner=3 outer=4: 24.0 GB/s
+# (the pre-SSA scan formulation measured 1.5 GB/s on the same shapes).
+# Defaults are chosen PER BACKEND at trace time: the swept optimum on
+# accelerators, 1/1 on CPU where the unrolled body (192 inlined rounds
+# per scan step) explodes XLA:CPU compile time and throughput is
+# emulation anyway. Env-tunable for other TPU generations. NOT cache
+# identity — digests are identical at any unroll.
+def _unroll(env_key: str, tpu_default: int) -> int:
+    val = _os.environ.get(env_key, "")
+    if val:
+        return int(val)
+    return tpu_default if jax.default_backend() != "cpu" else 1
+
+
+def _inner_unroll() -> int:
+    return _unroll("MAKISU_TPU_SHA_INNER_UNROLL", 3)
+
+
+def _block_unroll() -> int:
+    return _unroll("MAKISU_TPU_SHA_BLOCK_UNROLL", 4)
+
+
+def _round(a, b, c, d, e, f, g, h, k, wt):
+    """One SHA-256 round; returns the renamed (a..h)."""
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + k + wt
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
 def _compress(state, w16):
     """One SHA-256 block over all lanes. state: [8, L]; w16: [16, L].
 
-    Rounds run as a 64-step ``lax.scan`` carrying (a..h, W) where W is the
-    rolling 16-word message-schedule window: round t >= 16 computes
-    w_t = W[0] + s0(W[1]) + W[9] + s1(W[14]) and shifts it in; rounds < 16
-    select the block word instead (predicated, no control flow).
+    Rounds 0-15 are peeled (they consume the block words directly);
+    rounds 16-63 run as a 3-step ``lax.scan`` of 16 SSA rounds each.
+    The message-schedule window is 16 separate loop-carried [L] arrays
+    rotated by renaming, so no round anywhere stacks, concatenates,
+    gathers, or predicates — pure elementwise VPU work.
     """
-    ks = jnp.asarray(_K)
+    W = [w16[i] for i in range(16)]
+    v = tuple(state[i] for i in range(8))
+    for t in range(16):
+        v = _round(*v, jnp.uint32(int(_K[t])), W[t])
 
-    def round_step(carry, t):
-        abcs, W = carry  # abcs: [8, L], W: [16, L]
-        w_sched0 = _rotr(W[1], 7) ^ _rotr(W[1], 18) ^ (W[1] >> jnp.uint32(3))
-        w_sched1 = _rotr(W[14], 17) ^ _rotr(W[14], 19) ^ (W[14] >> jnp.uint32(10))
-        w_ext = W[0] + w_sched0 + W[9] + w_sched1
-        w_blk = jax.lax.dynamic_index_in_dim(
-            w16, jnp.minimum(t, 15), axis=0, keepdims=False)
-        wt = jnp.where(t < 16, w_blk, w_ext)
-        a, b, c, d, e, f, g, h = (abcs[i] for i in range(8))
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + ks[t] + wt
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        new = jnp.stack([t1 + s0 + maj, a, b, c, d + t1, e, f, g])
-        W = jnp.concatenate([W[1:], wt[None]], axis=0)
-        return (new, W), None
+    def sixteen(carry, ks):
+        v, W = carry
+        W = list(W)
+        for r in range(16):
+            w15 = W[(r + 1) % 16]
+            w2 = W[(r + 14) % 16]
+            s0w = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+            s1w = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+            wt = W[r] + s0w + W[(r + 9) % 16] + s1w
+            W[r] = wt
+            v = _round(*v, ks[r], wt)
+        return (v, tuple(W)), None
 
-    W0 = jnp.zeros_like(w16)
-    (abcs, _), _ = jax.lax.scan(
-        round_step, (state, W0), jnp.arange(64, dtype=jnp.int32),
-        unroll=ROUND_UNROLL)
-    return state + abcs
+    ks = jnp.asarray(_K[16:]).reshape(3, 16)
+    (v, _), _ = jax.lax.scan(sixteen, (v, tuple(W)), ks,
+                             unroll=_inner_unroll())
+    return state + jnp.stack(v)
 
 
 def sha256_words(words: jax.Array, n_blocks: jax.Array,
@@ -234,7 +269,8 @@ def sha256_lanes_impl(data: jax.Array, lengths: jax.Array,
         return jnp.where(keep, new, state), None
 
     state, _ = jax.lax.scan(step, state0,
-                            jnp.arange(cap // 64, dtype=jnp.int32))
+                            jnp.arange(cap // 64, dtype=jnp.int32),
+                            unroll=_block_unroll())
     return jnp.transpose(state)
 
 
