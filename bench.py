@@ -72,7 +72,7 @@ def _emit(stage: str, **fields) -> None:
 
 
 def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
-                      iters: int) -> tuple[float, float]:
+                      iters: int) -> tuple[float | None, float]:
     """Latency-cancelling device-loop timing.
 
     ``loop_fn(*args, n)`` must run its computation n times ON DEVICE
@@ -83,14 +83,13 @@ def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
     a host-side dispatch loop measured 1143 GB/s where the true
     sustained device number is ~20 GB/s (2026-07 session). Differencing
     a short and a long loop cancels the ~50ms tunnel round trip and the
-    readback. Returns (gbps, compile_secs)."""
-    import numpy as _np
-
+    readback. Returns (gbps, compile_secs); gbps is None when jitter
+    swamped the loop-length delta (no valid measurement)."""
     n_small, n_big = 2, 2 + iters
 
     def timed(n: int) -> float:
         t0 = time.perf_counter()
-        _np.asarray(loop_fn(*args, n))
+        np.asarray(loop_fn(*args, n))
         return time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -343,9 +342,15 @@ def main() -> int:
             for value in values:
                 alt, alt_err = _run_child({env_key: value}, sweep_timeout)
                 if "gbps" not in alt:
-                    sweep[value] = (
-                        f"error: stage={alt.get('stage_reached', 'none')}"
-                        f" ({alt_err[:120]})")
+                    if alt.get("big_timing_invalid") and not alt_err:
+                        # Child ran to completion; jitter swamped the
+                        # measurement — not an error.
+                        sweep[value] = "timing invalid (tunnel jitter)"
+                    else:
+                        sweep[value] = (
+                            f"error: stage="
+                            f"{alt.get('stage_reached', 'none')}"
+                            f" ({alt_err[:120]})")
                 elif alt.get("backend") != result.get("backend"):
                     # Fell back to another backend (flaky tunnel): the
                     # number is not comparable — record that, not it.

@@ -21,6 +21,10 @@ chunk-granular cache dedup); the layer's registry identity comes from
 the CPU digests. So a device failure mid-stream (backend died, tunnel
 dropped, OOM) degrades the session — the layer commits with an empty
 chunk list and whole-layer caching only — instead of failing the build.
+Backend-init HANGS (a wedged tunnel blocks ``jax.devices()`` forever and
+never raises) are caught the same way via a bounded, process-cached
+probe at session construction (ops/backend.py) — observed live on a
+v5e host whose tunnel wedged mid-session (2026-07).
 ``MAKISU_TPU_CHUNK_STRICT=1`` re-raises instead (tests/debugging).
 
 This is the long-stream scaling design the reference lacks (its hashing is
@@ -135,6 +139,15 @@ class ChunkSession:
                           for cap, lanes in _BUCKETS]
         self._chunks: list[Chunk] = []
         self._degraded: str | None = None  # failure summary once degraded
+        # Hang guard: a wedged TPU tunnel makes the first dispatch block
+        # forever in backend init, which no exception handler can catch.
+        # Probe (bounded, cached process-wide) before touching the
+        # device; on failure this layer degrades exactly like a
+        # mid-stream device error would.
+        from makisu_tpu.ops import backend as _backend
+        err = _backend.backend_ready()
+        if err is not None:
+            self._degrade("backend init", RuntimeError(err))
 
     # -- failure discipline ----------------------------------------------
 

@@ -1,0 +1,86 @@
+"""Bounded, cached JAX backend-readiness probe.
+
+The TPU plugin on tunneled hosts (axon) initializes through a network
+relay that has been observed to go from healthy (~20s init) to wedged
+(``make_c_api_client`` never returns) within one session. A hang never
+raises, so the chunker's exception-based degradation
+(chunker/cdc.py "failure discipline") cannot catch it — the first
+``gear_bitmap`` dispatch would block a build forever.
+
+``backend_ready()`` closes that gap: the first call runs
+``jax.devices()`` in a daemon thread and waits a bounded time; callers
+on the device plane consult it before their first dispatch and degrade
+(whole-layer caching, no chunk fingerprints) when the backend cannot
+come up. The probe result is cached process-wide, so a wedged tunnel
+costs ONE bounded wait per process — and if the stuck init eventually
+completes, later calls see the backend as ready (the probe thread keeps
+running and flips the cached state).
+
+The reference has no counterpart (its hashing is host-only,
+lib/builder/step/common.go:35-67); this is accelerator-era failure
+detection in the SURVEY §5 "failure recovery" sense.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+DEFAULT_TIMEOUT_SECONDS = 180.0
+
+_lock = threading.Lock()
+_done = threading.Event()
+_result: list = [None]  # [None] until the probe thread finishes;
+#                         then ["ok"] or [error summary string]
+_started = False
+_timed_out = False  # a full bounded wait already elapsed once
+
+
+def _probe() -> None:
+    try:
+        import jax
+
+        jax.devices()
+        _result[0] = "ok"
+    except Exception as e:  # noqa: BLE001 - init failures become a reason
+        _result[0] = f"backend init failed: {e}"
+    finally:
+        _done.set()
+
+
+def init_timeout() -> float:
+    """Seconds to wait for backend init (MAKISU_TPU_BACKEND_INIT_TIMEOUT;
+    0 disables the guard entirely — callers then block natively)."""
+    return float(os.environ.get("MAKISU_TPU_BACKEND_INIT_TIMEOUT",
+                                str(DEFAULT_TIMEOUT_SECONDS)))
+
+
+def backend_ready(timeout: float | None = None) -> str | None:
+    """Block (bounded) until the default JAX backend is initialized.
+
+    Returns None when the backend is ready, else a failure summary.
+    The wait is ``timeout`` seconds (default: ``init_timeout()``); a
+    timeout cannot cancel the underlying init — the daemon thread stays
+    parked in the plugin — but the caller gets control back and every
+    later call re-checks instantly (and picks up a late success).
+    """
+    global _started, _timed_out
+    if timeout is None:
+        timeout = init_timeout()
+    if timeout <= 0:
+        return None  # guard disabled: behave as before (block natively)
+    with _lock:
+        if not _started:
+            _started = True
+            threading.Thread(target=_probe, daemon=True,
+                             name="jax-backend-probe").start()
+    if _timed_out and not _done.is_set():
+        # One full bounded wait already elapsed in this process; don't
+        # charge it again per layer/session — report wedged instantly
+        # (a late init completion flips _done and is picked up above).
+        return "backend init still pending (tunnel wedged?)"
+    if not _done.wait(timeout):
+        _timed_out = True
+        return (f"backend init did not complete within {timeout:.0f}s "
+                "(tunnel wedged?)")
+    return None if _result[0] == "ok" else _result[0]

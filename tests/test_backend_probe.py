@@ -1,0 +1,99 @@
+"""Backend-readiness probe: the init-hang guard on the device plane.
+
+A wedged TPU tunnel blocks ``jax.devices()`` forever without raising
+(observed live, 2026-07), which the chunker's exception-based
+degradation cannot catch. These tests pin the probe's contract: bounded
+wait, process-cached result, late-success pickup, and ChunkSession
+degrading (or raising, under strict) when the backend cannot come up.
+"""
+
+import threading
+import time
+
+import pytest
+
+from makisu_tpu.ops import backend
+
+
+@pytest.fixture
+def fresh_probe(monkeypatch):
+    """Reset the module's cached probe state around a test."""
+    monkeypatch.setattr(backend, "_done", threading.Event())
+    monkeypatch.setattr(backend, "_result", [None])
+    monkeypatch.setattr(backend, "_started", False)
+    monkeypatch.setattr(backend, "_timed_out", False)
+    yield
+
+
+def test_ready_on_cpu_backend(fresh_probe):
+    # The test env runs the CPU backend: init is immediate.
+    assert backend.backend_ready(timeout=30.0) is None
+    # Cached: a second call with a tiny timeout is instant and still ok.
+    assert backend.backend_ready(timeout=0.001) is None
+
+
+def test_timeout_then_late_success(fresh_probe, monkeypatch):
+    release = threading.Event()
+
+    def slow_probe():
+        release.wait(5.0)
+        backend._result[0] = "ok"
+        backend._done.set()
+
+    monkeypatch.setattr(backend, "_probe", slow_probe)
+    err = backend.backend_ready(timeout=0.05)
+    assert err is not None and "did not complete" in err
+    # The full bounded wait is charged ONCE per process: while still
+    # pending, later calls report wedged instantly instead of waiting
+    # another full timeout per layer.
+    t0 = time.monotonic()
+    err2 = backend.backend_ready(timeout=30.0)
+    assert err2 is not None and "still pending" in err2
+    assert time.monotonic() - t0 < 1.0
+    # The hung init eventually finishes: later calls see ready.
+    release.set()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if backend.backend_ready(timeout=0.5) is None:
+            break
+    assert backend.backend_ready(timeout=0.5) is None
+
+
+def test_init_failure_is_reported(fresh_probe, monkeypatch):
+    def failing_probe():
+        backend._result[0] = "backend init failed: no plugin"
+        backend._done.set()
+
+    monkeypatch.setattr(backend, "_probe", failing_probe)
+    err = backend.backend_ready(timeout=5.0)
+    assert err == "backend init failed: no plugin"
+
+
+def test_zero_timeout_disables_guard(fresh_probe, monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_BACKEND_INIT_TIMEOUT", "0")
+    # Guard disabled: returns immediately without starting a probe.
+    assert backend.backend_ready() is None
+    assert backend._started is False
+
+
+def test_chunk_session_degrades_on_wedged_backend(monkeypatch):
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    monkeypatch.delenv("MAKISU_TPU_CHUNK_STRICT", raising=False)
+    monkeypatch.setattr(
+        backend, "backend_ready",
+        lambda timeout=None: "backend init did not complete within 180s")
+    s = ChunkSession()
+    s.update(b"x" * (1 << 20))
+    assert s.finish() == []  # degraded: no fingerprints, no hang
+
+
+def test_chunk_session_strict_raises_on_wedged_backend(monkeypatch):
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_STRICT", "1")
+    monkeypatch.setattr(
+        backend, "backend_ready",
+        lambda timeout=None: "backend init did not complete within 180s")
+    with pytest.raises(RuntimeError, match="did not complete"):
+        ChunkSession()
