@@ -91,15 +91,17 @@ def _gear_value(data: jax.Array) -> jax.Array:
     return z ^ (z >> jnp.uint32(15))
 
 
-def _windowed_sum(g: jax.Array) -> jax.Array:
+def _windowed_sum(g: jax.Array, shift=_shift_seq) -> jax.Array:
     """The log-doubling window accumulation over per-byte G-values —
     THE cache-identity-bearing Gear recurrence. Single definition on
-    purpose: the flat and blocked bitmap paths must cut identical
-    boundaries forever."""
+    purpose: every bitmap path (flat, blocked, and the Pallas kernel,
+    which passes its layout's ``shift``) must cut identical boundaries
+    forever. ``shift(h, m)`` must return h displaced by m sequence
+    positions with zero fill at the stream head."""
     h = g
     m = 1
     while m < WINDOW:
-        h = h + (_shift_seq(h, m) << jnp.uint32(m))
+        h = h + (shift(h, m) << jnp.uint32(m))
         m *= 2
     return h
 

@@ -6,13 +6,26 @@ the 5 shifted-add steps, the mask compare, and the bit-pack — inside one
 VMEM-resident kernel, writing only the packed bitmap (3% of input bytes)
 back to HBM.
 
-Formulation: the stream is restaged into overlapping rows
-``rows[r] = stream[r*C - H : r*C + C]`` (halo H = 128 bytes, left-padded
-with zeros at the stream head). Each row is then independent: position
-hashes read at most 31 predecessor bytes, all inside the row buffer. The
-zero-padding at the stream head makes positions < 31 differ from true
-zero-history hashes, but those sit far below the minimum chunk size and
-can never become cuts, so selected chunks are identical (asserted in
+Formulation (sublane-major): the stream is restaged into rows of ROW
+live bytes with a HALO-byte left halo, and each row is laid out
+COLUMN-major as a [32, (HALO+ROW)/32] tile: byte j of the row sits at
+[j % 32, j // 32]. Two properties make this the Mosaic-friendly layout:
+
+- The sequence shift by m (m = 1,2,4,8,16 in the log-doubling window
+  accumulation) becomes a sublane rotation with a one-lane borrow for
+  the wrapped sublanes — a concat on the sublane axis plus one static
+  lane shift, never an unaligned lane-axis slide.
+- The 32-position bit-pack becomes a reduction over the SUBLANE axis of
+  an int32 weighted mask (word c == column c), which Mosaic supports.
+  The first formulation reduced over a lane-split reshape
+  ([T, 8192] -> [T, 256, 32]), which Mosaic rejects ("unsupported shape
+  cast" on the i1 vector), and before the int32 rewrite the uint32
+  reduction was also rejected ("Reductions over unsigned integers not
+  implemented") — both observed on a real v5e (2026-07).
+
+The zero-filled halo at the stream head makes positions < 31 differ from
+true zero-history hashes, but those sit far below the minimum chunk size
+and can never become cuts, so selected chunks are identical (asserted in
 tests against the XLA path).
 
 Status: validated in Pallas interpret mode (CPU); opt-in on hardware via
@@ -30,9 +43,11 @@ import numpy as np
 
 from makisu_tpu.ops import gear
 
-HALO = 128            # row overlap; must be >= gear.WINDOW and % 128 == 0
-ROW = 8192            # live bytes per row (64 lanes of 128)
-ROW_TILE = 32         # rows per grid step (uint8 sublane tile)
+HALO = 128            # row overlap; must be >= gear.WINDOW and % 32 == 0
+ROW = 8192            # live bytes per row
+ROW_TILE = 8          # rows per grid step
+_HCOLS = HALO // 32   # halo columns in the sublane-major tile
+_CCOLS = ROW // 32    # live columns (= packed words per row)
 
 
 def pallas_enabled() -> bool:
@@ -40,15 +55,18 @@ def pallas_enabled() -> bool:
 
 
 def stage_rows(buf: np.ndarray, start: int, n: int) -> tuple[np.ndarray, int]:
-    """Restage ``buf[start:start+n]`` into overlapping halo rows.
+    """Restage ``buf[start:start+n]`` into sublane-major halo rows.
 
-    Returns (rows [R, HALO+ROW] uint8, R) with R padded to a multiple of
-    ROW_TILE; positions beyond ``n`` are zero-filled (callers mask the
-    bitmap tail).
+    Returns (rows, nrows): rows is uint8 [R, 32, _HCOLS+_CCOLS] with R
+    = nrows rounded UP to a multiple of ROW_TILE (trailing rows all
+    zero); nrows is the LIVE row count — callers slice the kernel's
+    bitmap to ``words[:nrows]``. Byte j of row r (j counts from the
+    halo start) sits at ``rows[r, j % 32, j // 32]``. Positions beyond
+    ``n`` are zero-filled (callers mask the bitmap tail).
     """
     nrows = max((n + ROW - 1) // ROW, 1)
     nrows_padded = ((nrows + ROW_TILE - 1) // ROW_TILE) * ROW_TILE
-    rows = np.zeros((nrows_padded, HALO + ROW), dtype=np.uint8)
+    flat = np.zeros((nrows_padded, HALO + ROW), dtype=np.uint8)
     for r in range(nrows):
         lo = start + r * ROW - HALO
         hi = min(start + r * ROW + ROW, start + n)
@@ -57,29 +75,39 @@ def stage_rows(buf: np.ndarray, start: int, n: int) -> tuple[np.ndarray, int]:
             dst_off = -lo
             lo = 0
         seg = buf[lo:hi]
-        rows[r, dst_off:dst_off + len(seg)] = seg
-    return rows, nrows
+        flat[r, dst_off:dst_off + len(seg)] = seg
+    # Column-major within each row: [R, COLS, 32] -> [R, 32, COLS].
+    cols = _HCOLS + _CCOLS
+    return np.ascontiguousarray(
+        flat.reshape(nrows_padded, cols, 32).transpose(0, 2, 1)), nrows
+
+
+def _shift_window(h: jax.Array, m: int) -> jax.Array:
+    """Sequence shift by m in the sublane-major layout.
+
+    shifted[t, s, c] = h[t, s-m, c] for s >= m, else h[t, s+32-m, c-1]
+    (zero at the first lane column) — i.e. position j-m where
+    j = c*32 + s.
+    """
+    down = h[:, :32 - m, :]
+    wrap = jnp.pad(h[:, 32 - m:, :], ((0, 0), (0, 0), (1, 0)))[:, :, :-1]
+    return jnp.concatenate([wrap, down], axis=1)
 
 
 def _gear_kernel(avg_bits: int, rows_ref, out_ref) -> None:
-    d = rows_ref[:]                                   # [T, HALO+ROW] uint8
-    h = gear._gear_value(d)                           # splitmix chain, VPU
-    m = 1
-    while m < gear.WINDOW:
-        shifted = jnp.pad(h, ((0, 0), (m, 0)))[:, :-m]
-        h = h + (shifted << jnp.uint32(m))
-        m *= 2
-    live = h[:, HALO:]                                # [T, ROW]
+    d = rows_ref[:]                           # [T, 32, COLS] uint8
+    # The recurrence itself is gear._windowed_sum — the ONE
+    # cache-identity-bearing definition — with this layout's shift.
+    h = gear._windowed_sum(gear._gear_value(d), shift=_shift_window)
+    live = h[:, :, _HCOLS:]                   # [T, 32, _CCOLS]
     mask = (live & jnp.uint32((1 << avg_bits) - 1)) == 0
-    # Bit-pack via an int32 reduction: Mosaic (TPU Pallas) rejects
-    # reductions over unsigned ints ("Reductions over unsigned integers
-    # not implemented", observed on a real v5e), and two's-complement
-    # wrap makes the int32 weighted sum bit-identical to the uint32 one
-    # (bit 31's weight is INT32_MIN; the sum wraps mod 2^32).
-    b = mask.reshape(mask.shape[0], ROW // 32, 32).astype(jnp.int32)
+    # Bit-pack via an int32 SUBLANE reduction (see module docstring):
+    # word c's bit s is position c*32+s; two's-complement wrap makes the
+    # int32 weighted sum bit-identical to the uint32 one.
     weights = jnp.int32(1) << jax.lax.broadcasted_iota(
-        jnp.int32, (1, 1, 32), 2)
-    packed = jnp.sum(b * weights, axis=-1, dtype=jnp.int32)
+        jnp.int32, (1, 32, 1), 1)
+    packed = jnp.sum(mask.astype(jnp.int32) * weights, axis=1,
+                     dtype=jnp.int32)         # [T, _CCOLS]
     out_ref[:] = jax.lax.bitcast_convert_type(packed, jnp.uint32)
 
 
@@ -87,19 +115,20 @@ def _gear_kernel(avg_bits: int, rows_ref, out_ref) -> None:
 def gear_bitmap_rows(rows: jax.Array,
                      avg_bits: int = gear.DEFAULT_AVG_BITS,
                      interpret: bool = False) -> jax.Array:
-    """uint8 rows [R, HALO+ROW] → packed candidate bitmap [R, ROW//32]."""
+    """uint8 rows [R, 32, COLS] → packed candidate bitmap [R, ROW//32]."""
     from jax.experimental import pallas as pl
 
     R = rows.shape[0]
-    if R % ROW_TILE or rows.shape[1] != HALO + ROW:
+    if R % ROW_TILE or rows.shape[1:] != (32, _HCOLS + _CCOLS):
         raise ValueError(f"bad row staging shape {rows.shape}")
     kernel = functools.partial(_gear_kernel, avg_bits)
     return pl.pallas_call(
         kernel,
         grid=(R // ROW_TILE,),
-        in_specs=[pl.BlockSpec((ROW_TILE, HALO + ROW), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((ROW_TILE, ROW // 32), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((R, ROW // 32), jnp.uint32),
+        in_specs=[pl.BlockSpec((ROW_TILE, 32, _HCOLS + _CCOLS),
+                               lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((ROW_TILE, _CCOLS), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, _CCOLS), jnp.uint32),
         interpret=interpret,
     )(rows)
 

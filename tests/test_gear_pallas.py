@@ -51,12 +51,16 @@ def test_pallas_with_offset_window():
 def test_stage_rows_shapes():
     buf = np.arange(20_000, dtype=np.uint32).astype(np.uint8)
     rows, nrows = gear_pallas.stage_rows(buf, 0, len(buf))
-    assert rows.shape[1] == gear_pallas.HALO + gear_pallas.ROW
+    cols = (gear_pallas.HALO + gear_pallas.ROW) // 32
+    assert rows.shape[1:] == (32, cols)
     assert rows.shape[0] % gear_pallas.ROW_TILE == 0
     assert nrows == (len(buf) + gear_pallas.ROW - 1) // gear_pallas.ROW
-    # Row 1's halo equals the last HALO bytes before its live region.
+    # Sublane-major: byte j of a row sits at [j % 32, j // 32]. Row 1's
+    # halo (its first HALO byte positions) equals the last HALO bytes
+    # before its live region.
+    flat1 = rows[1].T.reshape(-1)
     np.testing.assert_array_equal(
-        rows[1, :gear_pallas.HALO],
+        flat1[:gear_pallas.HALO],
         buf[gear_pallas.ROW - gear_pallas.HALO:gear_pallas.ROW])
 
 
