@@ -39,6 +39,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from makisu_tpu.ops import backend as _backend
 from makisu_tpu.ops import gear, sha256
 
 BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
@@ -98,7 +99,8 @@ class _LaneBatcher:
         self.flush()
         out: list[Chunk] = []
         for digests, meta in self.pending:
-            host = np.asarray(digests)  # sync point
+            host = _backend.sync_bounded(
+                digests, "lane digest readback")  # bounded sync point
             for i, (off, n) in enumerate(meta):
                 out.append(Chunk(off, n, host[i].astype(">u4").tobytes()))
         self.pending = []
@@ -144,7 +146,6 @@ class ChunkSession:
         # Probe (bounded, cached process-wide) before touching the
         # device; on failure this layer degrades exactly like a
         # mid-stream device error would.
-        from makisu_tpu.ops import backend as _backend
         err = _backend.backend_ready()
         if err is not None:
             self._degrade("backend init", RuntimeError(err))
@@ -221,9 +222,14 @@ class ChunkSession:
             try:
                 for b in self._batchers:
                     self._chunks.extend(b.drain())
+                _t = _backend.sync_timeout()
+                svc_timeout = _t if _t > 0 else None
                 for offset, length, fut in self._service_pending:
+                    # Bounded like the direct readbacks: a dead service
+                    # dispatcher must degrade the layer, not block it.
                     self._chunks.append(
-                        Chunk(offset, length, fut.result()))
+                        Chunk(offset, length,
+                              fut.result(timeout=svc_timeout)))
             except Exception as e:  # noqa: BLE001 - device plane
                 self._degrade("lane hashing", e)
         if self._degraded is not None:
@@ -259,9 +265,9 @@ class ChunkSession:
             self._process_block(self._inflight.pop(0))
 
     def _process_block(self, entry: tuple) -> None:
-        """Read back one block's bitmap (sync) and cut chunks."""
+        """Read back one block's bitmap (bounded sync) and cut chunks."""
         kind, words, meta, live, blk, base = entry
-        host_words = np.asarray(words)
+        host_words = _backend.sync_bounded(words, "gear bitmap readback")
         if kind == "pallas":
             from makisu_tpu.ops import gear_pallas
             nrows = meta

@@ -92,7 +92,10 @@ class HashService:
             data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
             lengths[i] = len(chunk)
         try:
-            words = np.asarray(sha256.sha256_lanes(data, lengths))
+            from makisu_tpu.ops import backend as _backend
+            words = _backend.sync_bounded(
+                sha256.sha256_lanes(data, lengths),
+                "shared-service digest readback")
         except BaseException as e:  # noqa: BLE001
             for _, fut, _ in batch:
                 fut.set_exception(e)

@@ -55,6 +55,51 @@ def init_timeout() -> float:
                                 str(DEFAULT_TIMEOUT_SECONDS)))
 
 
+def sync_timeout() -> float:
+    """Seconds to wait for a device→host readback
+    (MAKISU_TPU_SYNC_TIMEOUT; 0 disables the guard)."""
+    return float(os.environ.get("MAKISU_TPU_SYNC_TIMEOUT", "300"))
+
+
+def sync_bounded(x, what: str, timeout: float | None = None):
+    """``np.asarray(x)`` with a bounded wait.
+
+    Backend init is not the only place the tunnel can wedge: a backend
+    that initialized fine can stop answering mid-build, hanging the
+    readback sync point instead — which no exception discipline
+    catches. This runs the readback in a daemon thread and raises
+    ``TimeoutError`` after ``timeout`` seconds (default:
+    ``sync_timeout()``), turning the hang into a normal device-plane
+    error the chunker's degradation already handles. The abandoned
+    thread stays parked in the plugin; acceptable for a daemon.
+    """
+    import numpy as np
+
+    if timeout is None:
+        timeout = sync_timeout()
+    if timeout <= 0:
+        return np.asarray(x)
+    result: dict = {}
+
+    def run() -> None:
+        try:
+            result["v"] = np.asarray(x)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            result["e"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="device-readback")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(
+            f"{what} did not complete within {timeout:.0f}s "
+            "(tunnel wedged mid-build?)")
+    if "e" in result:
+        raise result["e"]
+    return result["v"]
+
+
 def backend_ready(timeout: float | None = None) -> str | None:
     """Block (bounded) until the default JAX backend is initialized.
 

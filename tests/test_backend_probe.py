@@ -97,3 +97,48 @@ def test_chunk_session_strict_raises_on_wedged_backend(monkeypatch):
         lambda timeout=None: "backend init did not complete within 180s")
     with pytest.raises(RuntimeError, match="did not complete"):
         ChunkSession()
+
+
+def test_sync_bounded_passthrough_and_timeout(monkeypatch):
+    import numpy as np
+
+    arr = np.arange(8)
+    assert (backend.sync_bounded(arr, "t") == arr).all()
+
+    class Hanging:
+        def __array__(self, dtype=None, copy=None):
+            time.sleep(10)
+            return np.zeros(1)
+
+    with pytest.raises(TimeoutError, match="wedged mid-build"):
+        backend.sync_bounded(Hanging(), "gear bitmap readback",
+                             timeout=0.1)
+
+
+def test_sync_bounded_propagates_errors():
+    class Exploding:
+        def __array__(self, dtype=None, copy=None):
+            raise RuntimeError("device died")
+
+    with pytest.raises(RuntimeError, match="device died"):
+        backend.sync_bounded(Exploding(), "t", timeout=5.0)
+
+
+def test_chunk_session_degrades_on_readback_hang(monkeypatch):
+    from makisu_tpu.chunker import cdc
+
+    monkeypatch.delenv("MAKISU_TPU_CHUNK_STRICT", raising=False)
+    monkeypatch.setenv("MAKISU_TPU_SYNC_TIMEOUT", "0.2")
+
+    real_bitmap = cdc.gear.gear_bitmap
+
+    class HangingWords:
+        def __array__(self, dtype=None, copy=None):
+            time.sleep(10)
+
+    monkeypatch.setattr(cdc.gear, "gear_bitmap",
+                        lambda *a, **k: HangingWords())
+    s = cdc.ChunkSession(block=64 * 1024)
+    s.update(b"y" * (256 * 1024))
+    assert s.finish() == []  # degraded within the bounded window
+    monkeypatch.setattr(cdc.gear, "gear_bitmap", real_bitmap)
