@@ -51,6 +51,10 @@ def make_parser() -> argparse.ArgumentParser:
                        help="registry config file or inline JSON")
     build.add_argument("--dest", default="",
                        help="write a docker-save tar here")
+    build.add_argument("--oci-dest", default="",
+                       help="write an OCI image layout here (a directory,"
+                            " or an oci-archive if the path ends in .tar)"
+                            " — consumable by podman/skopeo/containerd")
     build.add_argument("--target", default="",
                        help="build up to this stage only")
     build.add_argument("--build-arg", action="append", default=[],
@@ -232,6 +236,11 @@ def cmd_build(args) -> int:
             from makisu_tpu.docker.save import write_save_tar
             write_save_tar(store, target, args.dest)
             log.info("saved image tar to %s", args.dest)
+        if args.oci_dest:
+            from makisu_tpu.docker.oci import write_oci_layout
+            digest = write_oci_layout(store, target, args.oci_dest)
+            log.info("saved OCI layout to %s (manifest %s)",
+                     args.oci_dest, digest)
         if args.load:
             from makisu_tpu.docker.daemon import DockerClient
             from makisu_tpu.docker.save import write_save_tar
