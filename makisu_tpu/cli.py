@@ -101,6 +101,9 @@ def make_parser() -> argparse.ArgumentParser:
     pull.add_argument("image")
     pull.add_argument("--extract", default="",
                       help="untar the pulled rootfs into this directory")
+    pull.add_argument("--oci-dest", default="",
+                      help="also export the pulled image as an OCI "
+                           "layout (directory, or .tar oci-archive)")
     pull.add_argument("--storage", default="")
     pull.add_argument("--registry-config", default="")
 
@@ -281,6 +284,11 @@ def cmd_pull(args) -> int:
     with ImageStore(_storage_dir(args.storage)) as store:
         manifest = new_client(store, name, config_map=config_map).pull(name)
         log.info("pulled %s (%d layers)", name, len(manifest.layers))
+        if args.oci_dest:
+            from makisu_tpu.docker.oci import write_oci_layout
+            digest = write_oci_layout(store, name, args.oci_dest)
+            log.info("saved OCI layout to %s (manifest %s)",
+                     args.oci_dest, digest)
         if args.extract:
             from makisu_tpu.snapshot import MemFS
             os.makedirs(args.extract, exist_ok=True)

@@ -189,11 +189,33 @@ class RunDirective(Directive):
 
     @staticmethod
     def parse(args: str, commit: bool, state) -> "RunDirective":
-        args = replace_variables(args, state.require_stage_vars("run"))
-        arr = _json_array(args)
-        if arr is not None:
-            return RunDirective(args, commit, " ".join(arr))
-        return RunDirective(args, commit, args)
+        variables = state.require_stage_vars("run")
+        head, newline, body = args.partition("\n")
+        if not newline:
+            args = replace_variables(args, variables)
+            arr = _json_array(args)
+            if arr is not None:
+                return RunDirective(args, commit, " ".join(arr))
+            return RunDirective(args, commit, args)
+        # Heredoc forms (parse_file collected the body): build-time
+        # variables substitute only into the command head — bodies reach
+        # the shell verbatim (BuildKit semantics; $VAR there is the
+        # shell's business at run time). An EMPTY head line is
+        # parse_file's bare-script marker: the whole body is a verbatim
+        # shell script, no substitution anywhere.
+        from makisu_tpu.dockerfile.text import heredoc_tokens
+        if not head:
+            cmd = body
+        elif heredoc_tokens(head):
+            cmd = replace_variables(head, variables) + "\n" + body
+        else:
+            cmd = args
+        # Store cmd as args too: cache IDs hash step args (steps/base.py
+        # set_cache_id), so the SUBSTITUTED form must be the identity —
+        # otherwise two builds differing only in a build-arg value used
+        # in the command head would share a cache key and serve each
+        # other's layers.
+        return RunDirective(cmd, commit, cmd)
 
 
 def _shell_or_exec(directive: str, args: str, state) -> list[str]:

@@ -19,7 +19,7 @@ from makisu_tpu.dockerfile.directives import (
     FromDirective,
     ParseError,
 )
-from makisu_tpu.dockerfile.text import strip_inline_comment
+from makisu_tpu.dockerfile.text import heredoc_tokens, strip_inline_comment
 
 _COMMIT_RE = re.compile(r"\s*#!\s*commit\s*", re.I)
 
@@ -66,23 +66,62 @@ class ParsingState:
 
 
 def parse_line(line: str, state: ParsingState) -> Directive | None:
-    """Parse one logical line into a directive, or None for empty lines."""
+    """Parse one logical line into a directive, or None for empty lines.
+
+    A logical line may carry heredoc content below its first newline
+    (see ``parse_file``); comment/#!COMMIT handling applies to the
+    directive head only — bodies pass through verbatim.
+    """
+    head, _, body = line.partition("\n")
     commit = False
-    hash_idx = line.find("#")
+    hash_idx = head.find("#")
     if hash_idx != -1:
-        commit = bool(_COMMIT_RE.search(line[hash_idx:].lower()))
-        line = strip_inline_comment(line)
-    stripped = line.strip()
-    if not stripped:
+        commit = bool(_COMMIT_RE.search(head[hash_idx:].lower()))
+        head = strip_inline_comment(head)
+    stripped = head.strip()
+    if not stripped and not body:
         return None
     parts = stripped.split(None, 1)
-    if len(parts) != 2:
+    if len(parts) != 2 and not body:
         raise ValueError(f"failed to parse directive line: {line!r}")
-    name, args = parts[0].lower(), parts[1].strip()
+    name = parts[0].lower()
+    args = parts[1].strip() if len(parts) == 2 else ""
+    if body:
+        args = f"{args}\n{body}" if args else body
     cls = DIRECTIVES.get(name)
     if cls is None:
         raise ValueError(f"unsupported directive type: {parts[0]!r}")
     return cls.parse(args, commit, state)
+
+
+
+
+_HEREDOC_DIRECTIVES = {"run", "copy", "add"}
+
+
+def _collect_heredoc(lines: list[str], i: int, delim: str,
+                     strip_tabs: bool) -> tuple[list[str], list[str], int]:
+    """Consume raw lines until the terminator.
+
+    Returns (raw_lines, script_lines, next_i): raw_lines verbatim (for
+    the command form, where the shell re-interprets the heredoc itself,
+    including ``<<-`` tab stripping); script_lines tab-stripped when
+    ``strip_tabs`` (for the bare-script form, where WE are the heredoc
+    interpreter). Bodies are RAW either way: no comment stripping, no
+    continuation splicing, no blank-line removal — '#', '\\', and empty
+    lines are content.
+    """
+    raw_body: list[str] = []
+    script: list[str] = []
+    while i < len(lines):
+        raw = lines[i]
+        cand = raw.lstrip("\t") if strip_tabs else raw
+        if cand == delim:
+            return raw_body, script, i + 1
+        raw_body.append(raw)
+        script.append(cand)
+        i += 1
+    raise ValueError(f"unterminated heredoc: missing {delim!r} terminator")
 
 
 def parse_file(contents: str, build_args: dict[str, str] | None = None,
@@ -91,18 +130,87 @@ def parse_file(contents: str, build_args: dict[str, str] | None = None,
 
     ``build_args`` are the caller's ``--build-arg`` values, consulted when
     ARG directives declare matching names.
+
+    Heredocs (BuildKit Dockerfile syntax 1.4 — the reference predates
+    them entirely): a RUN line containing ``<<DELIM`` consumes the
+    following raw lines until ``DELIM`` as content. A bare
+    ``RUN <<DELIM`` runs the body as a shell script; a command form
+    (``RUN python3 <<DELIM`` / ``RUN cat <<EOF > f``) keeps the heredoc
+    syntax intact — the shell interprets it natively, so semantics
+    (including ``<<-`` tab stripping and quoted-delimiter expansion
+    suppression) are exactly sh's. COPY/ADD inline-file heredocs are
+    detected and rejected with a clear error (not yet supported) rather
+    than misparsed.
     """
     contents = contents.replace("\r\n", "\n")  # CRLF Dockerfiles
-    # Full-line comments go first so a trailing "\" on a comment line does
-    # not join it with the next line; then continuations are spliced.
-    kept = [l for l in contents.split("\n") if l.strip(" \t")
-            and l.strip(" \t")[0] != "#"]
-    spliced = "\n".join(kept).replace("\\\n", "")
-
+    lines = contents.split("\n")
     state = ParsingState(build_args)
-    for lineno, line in enumerate(spliced.split("\n"), start=1):
+    i = 0
+    while i < len(lines):
+        raw = lines[i]
+        lineno = i + 1
+        stripped = raw.strip(" \t")
+        if not stripped or stripped[0] == "#":
+            i += 1
+            continue
+        # Logical line: splice "\"-continuations, skipping interleaved
+        # full-line comments and blanks (docker semantics, same as the
+        # previous filter-then-splice implementation).
+        head = raw
+        i += 1
+        while head.endswith("\\"):
+            while i < len(lines) and (not lines[i].strip(" \t")
+                                      or lines[i].strip(" \t")[0] == "#"):
+                i += 1
+            if i >= len(lines):
+                break
+            head = head[:-1] + lines[i]
+            i += 1
+
+        name = head.strip().split(None, 1)[0].lower() if head.strip() else ""
+        logical = head
+        if name in _HEREDOC_DIRECTIVES:
+            try:
+                tokens = heredoc_tokens(head)
+                if tokens and name in ("copy", "add"):
+                    raise ValueError(
+                        f"{name.upper()} heredoc file sources are not "
+                        "supported yet (RUN heredocs are)")
+                if tokens:
+                    # Bare form: the directive's entire argument (inline
+                    # comments aside) is the one heredoc token.
+                    cleaned = strip_inline_comment(head).strip()
+                    cleaned_parts = cleaned.split(None, 1)
+                    bare = (len(tokens) == 1 and len(cleaned_parts) == 2
+                            and cleaned_parts[1].strip()
+                            == head[tokens[0][2][0]:tokens[0][2][1]])
+                    segments = []
+                    for delim, strip_tabs, _span in tokens:
+                        raw_body, script, i = _collect_heredoc(
+                            lines, i, delim, strip_tabs)
+                        if bare:
+                            segments.extend(script)
+                        else:
+                            # Keep the shell's own heredoc: body
+                            # verbatim (pre-tab-strip) + terminator
+                            # line — sh applies <<- tab stripping
+                            # itself.
+                            segments.extend(raw_body + [delim])
+                    if bare:
+                        # Head minus the token (any #!COMMIT marker
+                        # stays); body is the script. The EMPTY second
+                        # line is a marker: RunDirective reads it as
+                        # "bare script — no variable substitution".
+                        lo, hi = tokens[0][2]
+                        logical = "\n".join(
+                            [(head[:lo] + head[hi:]).rstrip(), "",
+                             *segments])
+                    else:
+                        logical = "\n".join([head, *segments])
+            except ValueError as e:
+                raise ValueError(f"line {lineno}: {e}") from e
         try:
-            directive = parse_line(line, state)
+            directive = parse_line(logical, state)
         except ValueError as e:
             raise ValueError(f"line {lineno}: {e}") from e
         if directive is not None:

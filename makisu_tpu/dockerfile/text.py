@@ -16,6 +16,8 @@ parse_key_values.go) but written as index-based recursive descent:
 
 from __future__ import annotations
 
+import re
+
 
 class TextParseError(ValueError):
     """Malformed directive text (unbalanced quotes, bad ${} syntax, ...)."""
@@ -339,3 +341,51 @@ def strip_inline_comment(line: str) -> str:
         if balanced == 2:
             return line[:idx]
     return line
+
+
+# Heredoc token on a directive line (BuildKit Dockerfile syntax 1.4):
+# ``<<EOF`` / ``<<-EOF`` / ``<<'EOF'`` / ``<<"EOF"``. Not heredocs:
+# ``<<<`` (shell here-string), ``<<`` inside quotes, and ``<<`` that is
+# not at the start of a shell word — BuildKit's rule, which keeps
+# arithmetic shifts (``$((1<<8))``) and fd-redirects (``2<<X``) from
+# being misread as heredoc openers.
+_HEREDOC_RE = re.compile(r"<<(-?)(['\"]?)(\w+)\2")
+
+
+def heredoc_tokens(head: str) -> list[tuple[str, bool, tuple[int, int]]]:
+    """(delimiter, strip_tabs, span) for each heredoc token outside
+    quotes, in order of appearance."""
+    out = []
+    quote = ""
+    word_start = True  # are we at the start of a shell word?
+    i = 0
+    while i < len(head):
+        c = head[i]
+        if quote:
+            if c == "\\" and quote == '"':
+                i += 2  # escaped char inside double quotes
+                continue
+            if c == quote:
+                quote = ""
+            i += 1
+            continue
+        if c == "\\":
+            i += 2  # escaped char outside quotes (e.g. it\'s)
+            word_start = False
+            continue
+        if c in "'\"":
+            quote = c
+            word_start = False
+            i += 1
+            continue
+        if (word_start and head.startswith("<<", i)
+                and not head.startswith("<<<", i)):
+            m = _HEREDOC_RE.match(head, i)
+            if m:
+                out.append((m.group(3), m.group(1) == "-", m.span()))
+                i = m.end()
+                word_start = False
+                continue
+        word_start = c in " \t;|&("
+        i += 1
+    return out

@@ -119,3 +119,33 @@ def test_build_oci_dest_tar_deterministic(tmp_path, built_store):
         assert f"blobs/sha256/{man_hex}" in names
         for m in tf.getmembers():
             assert m.mtime == 0 and m.uid == 0 and m.gid == 0
+
+
+def test_pull_oci_dest(tmp_path):
+    """pull --oci-dest exports the pulled image as an OCI layout."""
+    from makisu_tpu.registry import RegistryFixture, make_test_image
+    from makisu_tpu.registry import client as client_mod
+
+    fixture = RegistryFixture()
+    manifest, _, blobs = make_test_image({"bin/tool": b"#!x"})
+    fixture.serve_image("library/busy", "v2", manifest, blobs)
+    client_mod.set_transport_factory(lambda name: fixture)
+    try:
+        dest = tmp_path / "oci"
+        rc = cli.main(["pull", "busy:v2", "--oci-dest", str(dest),
+                       "--storage", str(tmp_path / "s")])
+    finally:
+        client_mod.set_transport_factory(None)
+    assert rc == 0
+    index = json.loads((dest / "index.json").read_bytes())
+    [entry] = index["manifests"]
+    assert entry["annotations"][
+        "org.opencontainers.image.ref.name"] == "library/busy:v2"
+    man_hex = entry["digest"].removeprefix("sha256:")
+    oci_man = json.loads(
+        (dest / "blobs" / "sha256" / man_hex).read_bytes())
+    # The layer blob is byte-identical to the registry's blob.
+    [layer] = oci_man["layers"]
+    lay_hex = layer["digest"].removeprefix("sha256:")
+    assert _sha256_hex(
+        (dest / "blobs" / "sha256" / lay_hex).read_bytes()) == lay_hex
