@@ -430,18 +430,81 @@ def _parse_add_copy(directive: str, args_text: str, tokens: list[str]):
     return chown, preserve_owner, parsed[:-1], parsed[-1]
 
 
+def _take_inline_files(
+    directive: str, srcs: list[str], dst: str, state,
+    variables: dict[str, str],
+) -> tuple[list[str], list[tuple[str, str]], list[tuple[str, str]]]:
+    """Split heredoc sources (``<<NAME`` tokens, BuildKit syntax 1.4)
+    from real sources, pairing them with the bodies parse_file stashed
+    in ``state.pending_heredocs``. Each becomes an inline file named by
+    its delimiter; bodies get build-time variable expansion unless the
+    delimiter was quoted (``<<'NAME'``).
+
+    Returns (real_srcs, inline_files, ordered) where ``ordered`` is
+    [("src", path) | ("inline", name)] in the line's left-to-right
+    source order — docker applies sources in order, so later sources
+    overwrite earlier ones on name collisions and the steps must
+    preserve that.
+    """
+    pending = {name: (content, quoted)
+               for name, content, quoted in state.pending_heredocs}
+    state.pending_heredocs = []
+    if dst.startswith("<<"):
+        raise ParseError(directive, dst,
+                         "a heredoc cannot be the destination")
+    real: list[str] = []
+    inline: list[tuple[str, str]] = []
+    ordered: list[tuple[str, str]] = []
+    seen: set[str] = set()
+    for src in srcs:
+        if not src.startswith("<<"):
+            real.append(src)
+            ordered.append(("src", src))
+            continue
+        name = src.lstrip("<").lstrip("-").strip("\"'")
+        if name in (".", ".."):
+            raise ParseError(directive, src,
+                             f"invalid heredoc file name {name!r}")
+        if name not in pending:
+            raise ParseError(directive, src,
+                             f"heredoc source {src!r} has no body")
+        if name in seen:
+            raise ParseError(
+                directive, src,
+                f"duplicate heredoc file name {name!r} on one line")
+        seen.add(name)
+        content, quoted = pending.pop(name)
+        if not quoted:
+            content = replace_variables(content, variables)
+        inline.append((name, content))
+        ordered.append(("inline", name))
+    if pending:
+        raise ParseError(
+            directive, " ".join(sorted(pending)),
+            "heredoc body not referenced by any source on the line")
+    return real, inline, ordered
+
+
 @dataclasses.dataclass
 class AddDirective(Directive):
     chown: str = ""
     preserve_owner: bool = False
     srcs: list[str] = dataclasses.field(default_factory=list)
     dst: str = ""
+    inline_files: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+    ordered_sources: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)
 
     @staticmethod
     def parse(args: str, commit: bool, state) -> "AddDirective":
-        args = replace_variables(args, state.require_stage_vars("add"))
+        variables = state.require_stage_vars("add")
+        args = replace_variables(args, variables)
         chown, preserve, srcs, dst = _parse_add_copy("add", args, args.split())
-        return AddDirective(args, commit, chown, preserve, srcs, dst)
+        srcs, inline, ordered = _take_inline_files(
+            "add", srcs, dst, state, variables)
+        return AddDirective(args, commit, chown, preserve, srcs, dst,
+                            inline, ordered)
 
 
 @dataclasses.dataclass
@@ -451,10 +514,15 @@ class CopyDirective(Directive):
     srcs: list[str] = dataclasses.field(default_factory=list)
     dst: str = ""
     from_stage: str = ""
+    inline_files: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+    ordered_sources: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)
 
     @staticmethod
     def parse(args: str, commit: bool, state) -> "CopyDirective":
-        args = replace_variables(args, state.require_stage_vars("copy"))
+        variables = state.require_stage_vars("copy")
+        args = replace_variables(args, variables)
         tokens = args.split()
         from_stage = ""
         for i, tok in enumerate(tokens[:2]):
@@ -466,8 +534,13 @@ class CopyDirective(Directive):
                 tokens = tokens[:i] + tokens[i + 1:]
                 break
         chown, preserve, srcs, dst = _parse_add_copy("copy", args, tokens)
+        srcs, inline, ordered = _take_inline_files(
+            "copy", srcs, dst, state, variables)
+        if inline and from_stage:
+            raise ParseError("copy", args,
+                             "heredoc sources cannot combine with --from")
         return CopyDirective(args, commit, chown, preserve, srcs, dst,
-                             from_stage)
+                             from_stage, inline, ordered)
 
 
 DIRECTIVES: dict[str, type] = {

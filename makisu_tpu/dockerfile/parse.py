@@ -44,6 +44,9 @@ class ParsingState:
         self.passed_args: dict[str, str] = dict(passed_args or {})
         self.global_args: dict[str, str] = {}
         self.stage_vars: dict[str, str] | None = None  # None until first FROM
+        # COPY/ADD heredoc bodies collected by parse_file for the
+        # directive currently being parsed: (delimiter, content, quoted).
+        self.pending_heredocs: list[tuple[str, str, bool]] = []
 
     def current_or_global_vars(self) -> dict[str, str]:
         return self.stage_vars if self.stage_vars is not None else self.global_args
@@ -138,9 +141,10 @@ def parse_file(contents: str, build_args: dict[str, str] | None = None,
     (``RUN python3 <<DELIM`` / ``RUN cat <<EOF > f``) keeps the heredoc
     syntax intact — the shell interprets it natively, so semantics
     (including ``<<-`` tab stripping and quoted-delimiter expansion
-    suppression) are exactly sh's. COPY/ADD inline-file heredocs are
-    detected and rejected with a clear error (not yet supported) rather
-    than misparsed.
+    suppression) are exactly sh's. A COPY/ADD ``<<NAME`` source becomes
+    an inline file named by its delimiter (variable-expanded unless the
+    delimiter is quoted), staged and copied with normal docker
+    semantics in left-to-right source order.
     """
     contents = contents.replace("\r\n", "\n")  # CRLF Dockerfiles
     lines = contents.split("\n")
@@ -173,19 +177,25 @@ def parse_file(contents: str, build_args: dict[str, str] | None = None,
             try:
                 tokens = heredoc_tokens(head)
                 if tokens and name in ("copy", "add"):
-                    raise ValueError(
-                        f"{name.upper()} heredoc file sources are not "
-                        "supported yet (RUN heredocs are)")
-                if tokens:
+                    # Inline file sources: each body becomes a staged
+                    # file named by its delimiter; CopyDirective/
+                    # AddDirective consume them from the parse state.
+                    for delim, strip_tabs, quoted, _span in tokens:
+                        _raw, script, i = _collect_heredoc(
+                            lines, i, delim, strip_tabs)
+                        content = "".join(s + "\n" for s in script)
+                        state.pending_heredocs.append(
+                            (delim, content, quoted))
+                elif tokens:
                     # Bare form: the directive's entire argument (inline
                     # comments aside) is the one heredoc token.
                     cleaned = strip_inline_comment(head).strip()
                     cleaned_parts = cleaned.split(None, 1)
                     bare = (len(tokens) == 1 and len(cleaned_parts) == 2
                             and cleaned_parts[1].strip()
-                            == head[tokens[0][2][0]:tokens[0][2][1]])
+                            == head[tokens[0][3][0]:tokens[0][3][1]])
                     segments = []
-                    for delim, strip_tabs, _span in tokens:
+                    for delim, strip_tabs, _quoted, _span in tokens:
                         raw_body, script, i = _collect_heredoc(
                             lines, i, delim, strip_tabs)
                         if bare:
@@ -201,7 +211,7 @@ def parse_file(contents: str, build_args: dict[str, str] | None = None,
                         # stays); body is the script. The EMPTY second
                         # line is a marker: RunDirective reads it as
                         # "bare script — no variable substitution".
-                        lo, hi = tokens[0][2]
+                        lo, hi = tokens[0][3]
                         logical = "\n".join(
                             [(head[:lo] + head[hi:]).rstrip(), "",
                              *segments])

@@ -349,12 +349,16 @@ def strip_inline_comment(line: str) -> str:
 # not at the start of a shell word — BuildKit's rule, which keeps
 # arithmetic shifts (``$((1<<8))``) and fd-redirects (``2<<X``) from
 # being misread as heredoc openers.
-_HEREDOC_RE = re.compile(r"<<(-?)(['\"]?)(\w+)\2")
+# Delimiter charset per BuildKit: word chars plus '.' and '-' (heredoc
+# file names like <<config.ini).
+_HEREDOC_RE = re.compile(r"<<(-?)(['\"]?)([A-Za-z0-9_.-]+)\2")
 
 
-def heredoc_tokens(head: str) -> list[tuple[str, bool, tuple[int, int]]]:
-    """(delimiter, strip_tabs, span) for each heredoc token outside
-    quotes, in order of appearance."""
+def heredoc_tokens(
+        head: str) -> list[tuple[str, bool, bool, tuple[int, int]]]:
+    """(delimiter, strip_tabs, quoted, span) for each heredoc token
+    outside quotes, in order of appearance. ``quoted`` (<<'EOF') means
+    no build-time variable expansion in the body (BuildKit/sh rule)."""
     out = []
     quote = ""
     word_start = True  # are we at the start of a shell word?
@@ -382,7 +386,8 @@ def heredoc_tokens(head: str) -> list[tuple[str, bool, tuple[int, int]]]:
                 and not head.startswith("<<<", i)):
             m = _HEREDOC_RE.match(head, i)
             if m:
-                out.append((m.group(3), m.group(1) == "-", m.span()))
+                out.append((m.group(3), m.group(1) == "-",
+                            bool(m.group(2)), m.span()))
                 i = m.end()
                 word_start = False
                 continue

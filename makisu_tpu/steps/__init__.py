@@ -35,14 +35,16 @@ def new_step(ctx: BuildContext, directive: df.Directive,
     d = directive
     if isinstance(d, df.AddDirective):
         step = AddStep(d.args, d.chown, d.srcs, d.dst, d.commit,
-                       d.preserve_owner)
+                       d.preserve_owner, d.inline_files,
+                       d.ordered_sources)
     elif isinstance(d, df.ArgDirective):
         step = ArgStep(d.args, d.name, d.resolved_val, d.commit)
     elif isinstance(d, df.CmdDirective):
         step = CmdStep(d.args, d.cmd, d.commit)
     elif isinstance(d, df.CopyDirective):
         step = CopyStep(d.args, d.chown, d.from_stage, d.srcs, d.dst,
-                        d.commit, d.preserve_owner)
+                        d.commit, d.preserve_owner, d.inline_files,
+                        d.ordered_sources)
     elif isinstance(d, df.EntrypointDirective):
         step = EntrypointStep(d.args, d.entrypoint, d.commit)
     elif isinstance(d, df.EnvDirective):

@@ -21,7 +21,10 @@ from makisu_tpu.utils import pathutils, sysutils
 class AddCopyStep(BuildStep):
     def __init__(self, directive: str, args: str, chown: str,
                  from_stage: str, srcs: list[str], dst: str,
-                 commit: bool, preserve_owner: bool) -> None:
+                 commit: bool, preserve_owner: bool,
+                 inline_files: list[tuple[str, str]] | None = None,
+                 ordered_sources: list[tuple[str, str]] | None = None,
+                 ) -> None:
         super().__init__(args, commit)
         self.directive = directive
         self.chown = chown
@@ -29,7 +32,21 @@ class AddCopyStep(BuildStep):
         self.srcs = [s.strip("\"'") for s in srcs]
         self.dst = dst.strip("\"'")
         self.preserve_owner = preserve_owner
-        if len(self.srcs) > 1 and not (
+        # Heredoc file sources (BuildKit syntax 1.4): (name, content)
+        # staged as real files at execute time, then copied with normal
+        # docker semantics (a single inline file renames onto a file
+        # dst; multiple require a directory dst like any other source).
+        self.inline_files = list(inline_files or [])
+        # Left-to-right source order (("src", path) | ("inline", name)):
+        # docker applies sources in order, so later ones overwrite
+        # earlier on name collisions. Default (direct construction in
+        # tests): real sources then inline.
+        self.ordered_sources = (list(ordered_sources)
+                                if ordered_sources is not None else
+                                [("src", s) for s in self.srcs]
+                                + [("inline", n)
+                                   for n, _ in self.inline_files])
+        if len(self.srcs) + len(self.inline_files) > 1 and not (
                 self.dst.endswith("/") or self.dst in (".", "..")):
             raise ValueError(
                 'copying multiple sources: destination must end with "/"')
@@ -47,14 +64,15 @@ class AddCopyStep(BuildStep):
             return ctx.copy_from_root(self.from_stage)
         return ctx.context_dir
 
-    def _resolve_sources(self, ctx: BuildContext) -> list[str]:
+    def _resolve_sources(self, ctx: BuildContext,
+                         srcs: list[str] | None = None) -> list[str]:
         """Glob-expand sources against the source root (absolute paths).
         Context sources matching .dockerignore are invisible — the same
         "never entered the context" semantics docker gives them."""
         root = self._source_root(ctx)
         check_ignore = not self.from_stage
         out: list[str] = []
-        for src in self.srcs:
+        for src in (self.srcs if srcs is None else srcs):
             pattern = os.path.join(root, pathutils.rel_path(src))
             matches = glob(pattern)
             if check_ignore:
@@ -80,6 +98,16 @@ class AddCopyStep(BuildStep):
             # Cross-stage copies rely on chained stage cache IDs instead.
             for source in self._resolve_sources(ctx):
                 checksum = self._checksum_tree(ctx, source, checksum)
+        for name, content in self.inline_files:
+            # Inline heredoc files are content too (their bodies carry
+            # substituted build args, so identity must track them).
+            # Length-framed: bare concatenation would let different
+            # (name, content) partitions with equal concatenations
+            # collide into one cache ID.
+            frame = f"{len(name)}:{len(content)}:".encode()
+            checksum = zlib.crc32(frame, checksum)
+            checksum = zlib.crc32(name.encode(), checksum)
+            checksum = zlib.crc32(content.encode(), checksum)
         self.cache_id = format(checksum & 0xFFFFFFFF, "x")
 
     def _checksum_tree(self, ctx: BuildContext, path: str,
@@ -109,35 +137,91 @@ class AddCopyStep(BuildStep):
                     return checksum
                 checksum = zlib.crc32(chunk, checksum)
 
+    def _stage_inline_files(self, ctx: BuildContext) -> str:
+        """Write heredoc bodies as real files in the build sandbox (they
+        must outlive execute: the MemFS copy-op diff reads file bytes at
+        commit time). The staging dir is keyed by cache_id so steps
+        never collide. UTF-8 explicitly — cache identity hashed
+        content.encode(), the bytes on disk must match regardless of
+        host locale."""
+        stage_dir = os.path.join(ctx.image_store.sandbox_dir,
+                                 "heredocs", self.cache_id or "x")
+        os.makedirs(stage_dir, exist_ok=True)
+        for name, content in self.inline_files:
+            path = os.path.join(stage_dir, name)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(content)
+            os.chmod(path, 0o644)
+            # Epoch mtime: generated files carry no meaningful
+            # timestamp, and a deterministic one makes heredoc layers
+            # byte-reproducible across rebuilds (a live mtime would
+            # change the layer tar's bytes every build) AND keeps the
+            # header-similarity diff from ever confusing a staged file
+            # with a same-sized real source written the same second.
+            os.utime(path, (0, 0))
+        return stage_dir
+
     def execute(self, ctx: BuildContext, modify_fs: bool) -> None:
-        source_root = self._source_root(ctx)
-        rel_paths = [pathutils.trim_root(s, source_root)
-                     for s in self._resolve_sources(ctx)]
         blacklist = list(ctx.base_blacklist) + [ctx.image_store.root]
-        if not self.from_stage:
-            # .dockerignore exclusions ride the blacklist, which both
-            # the on-disk Copier and the MemFS copy-op diff honor.
-            blacklist += ctx.context_excluded_paths()
-        op = CopyOperation(
-            rel_paths, source_root, self.logical_working_dir, self.dst,
-            chown=self.chown, blacklist=blacklist,
-            internal=bool(self.from_stage),
-            preserve_owner=self.preserve_owner)
-        ctx.copy_ops.append(op)
-        if modify_fs:
-            op.execute(eval_symlinks, ctx.root_dir)
+        stage_dir = (self._stage_inline_files(ctx)
+                     if self.inline_files else "")
+        # One CopyOperation per consecutive run of same-kind sources,
+        # in the line's left-to-right order: docker applies sources in
+        # order, so a later source overwrites an earlier one on a name
+        # collision — real files and inline heredocs interleave.
+        runs: list[tuple[str, list[str]]] = []
+        for kind, val in self.ordered_sources:
+            if runs and runs[-1][0] == kind:
+                runs[-1][1].append(val)
+            else:
+                runs.append((kind, [val]))
+        if not runs:
+            runs = [("src", [])]  # preserve empty-sources error path
+        inline_contents = dict(self.inline_files)
+        for kind, vals in runs:
+            if kind == "src":
+                source_root = self._source_root(ctx)
+                rel_paths = [
+                    pathutils.trim_root(s, source_root)
+                    for s in self._resolve_sources(ctx, srcs=vals)]
+                ctx_blacklist = list(blacklist)
+                if not self.from_stage:
+                    # .dockerignore exclusions ride the blacklist, which
+                    # both the on-disk Copier and the MemFS copy-op diff
+                    # honor.
+                    ctx_blacklist += ctx.context_excluded_paths()
+                op = CopyOperation(
+                    rel_paths, source_root, self.logical_working_dir,
+                    self.dst, chown=self.chown, blacklist=ctx_blacklist,
+                    internal=bool(self.from_stage),
+                    preserve_owner=self.preserve_owner)
+            else:
+                assert all(v in inline_contents for v in vals)
+                op = CopyOperation(
+                    vals, stage_dir, self.logical_working_dir, self.dst,
+                    chown=self.chown, blacklist=blacklist,
+                    internal=True, preserve_owner=self.preserve_owner)
+            ctx.copy_ops.append(op)
+            if modify_fs:
+                op.execute(eval_symlinks, ctx.root_dir)
 
 
 class AddStep(AddCopyStep):
     def __init__(self, args: str, chown: str, srcs: list[str], dst: str,
-                 commit: bool, preserve_owner: bool) -> None:
+                 commit: bool, preserve_owner: bool,
+                 inline_files: list[tuple[str, str]] | None = None,
+                 ordered_sources: list[tuple[str, str]] | None = None,
+                 ) -> None:
         super().__init__("ADD", args, chown, "", srcs, dst, commit,
-                         preserve_owner)
+                         preserve_owner, inline_files, ordered_sources)
 
 
 class CopyStep(AddCopyStep):
     def __init__(self, args: str, chown: str, from_stage: str,
                  srcs: list[str], dst: str, commit: bool,
-                 preserve_owner: bool) -> None:
+                 preserve_owner: bool,
+                 inline_files: list[tuple[str, str]] | None = None,
+                 ordered_sources: list[tuple[str, str]] | None = None,
+                 ) -> None:
         super().__init__("COPY", args, chown, from_stage, srcs, dst, commit,
-                         preserve_owner)
+                         preserve_owner, inline_files, ordered_sources)

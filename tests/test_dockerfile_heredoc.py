@@ -4,7 +4,10 @@ predates heredocs entirely; capability beyond parity).
 Parser-level: bare ``RUN <<EOF`` bodies become shell scripts; command
 forms keep the heredoc for sh to interpret natively; bodies are raw
 (no comment stripping, no continuation splicing, no build-arg
-substitution); COPY/ADD heredocs error clearly.
+substitution). COPY/ADD heredocs become inline files named by their
+delimiter (variable-expanded unless the delimiter is quoted), staged
+and copied with normal docker semantics, content-addressed in cache
+IDs.
 """
 
 import pytest
@@ -100,11 +103,54 @@ def test_unterminated_heredoc_errors_with_line():
         parse_file("FROM scratch\nRUN <<EOF\necho never ends\n")
 
 
-def test_copy_heredoc_rejected_clearly():
-    with pytest.raises(ValueError, match="COPY heredoc.*not.*supported"):
-        parse_file("FROM scratch\n"
-                   "COPY <<EOF /app/config\n"
-                   "key=value\n"
+def test_copy_heredoc_parses_inline_file():
+    from makisu_tpu.dockerfile.directives import CopyDirective
+
+    stages = parse_file("FROM scratch\n"
+                        "ENV REGION=eu\n"
+                        "COPY <<EOF /app/config\n"
+                        "region=${REGION}\n"
+                        "EOF\n")
+    [d] = [d for d in stages[0].directives
+           if isinstance(d, CopyDirective)]
+    assert d.srcs == []
+    assert d.inline_files == [("EOF", "region=eu\n")]
+    assert d.dst == "/app/config"
+
+
+def test_copy_heredoc_quoted_delim_no_substitution():
+    from makisu_tpu.dockerfile.directives import CopyDirective
+
+    stages = parse_file("FROM scratch\n"
+                        "ENV REGION=eu\n"
+                        "COPY <<'EOF' /app/config\n"
+                        "region=${REGION}\n"
+                        "EOF\n")
+    [d] = [d for d in stages[0].directives
+           if isinstance(d, CopyDirective)]
+    assert d.inline_files == [("EOF", "region=${REGION}\n")]
+
+
+def test_copy_multiple_heredocs_named_by_delimiter():
+    from makisu_tpu.dockerfile.directives import CopyDirective
+
+    stages = parse_file("FROM scratch\n"
+                        "COPY <<a.txt <<b.txt /cfg/\n"
+                        "alpha\n"
+                        "a.txt\n"
+                        "beta\n"
+                        "b.txt\n")
+    [d] = [d for d in stages[0].directives
+           if isinstance(d, CopyDirective)]
+    assert d.inline_files == [("a.txt", "alpha\n"), ("b.txt", "beta\n")]
+
+
+def test_copy_heredoc_with_from_rejected():
+    with pytest.raises(ValueError, match="cannot combine with --from"):
+        parse_file("FROM scratch AS base\n"
+                   "FROM scratch\n"
+                   "COPY --from=base <<EOF /x/\n"
+                   "y\n"
                    "EOF\n")
 
 
@@ -186,3 +232,159 @@ def test_heredoc_cache_identity_tracks_build_args():
     # Cache IDs hash step args: substituted head must differ.
     assert d3.args != d4.args
     assert "python3" in d3.args and "python4" in d4.args
+
+
+def _build_layers(tmp_path, dockerfile, ctx_files=None):
+    from makisu_tpu.builder import BuildPlan
+    from makisu_tpu.cache import NoopCacheManager
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.storage import ImageStore
+
+    root = tmp_path / "root"
+    root.mkdir()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    for name, content in (ctx_files or {}).items():
+        (ctx_dir / name).write_text(content)
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    stages = parse_file(dockerfile)
+    plan = BuildPlan(ctx, ImageName("", "t/ch", "latest"), [],
+                     NoopCacheManager(), stages, allow_modify_fs=True,
+                     force_commit=False)
+    manifest = plan.execute()
+    import gzip
+    import io
+    import tarfile
+    contents = {}
+    for desc in manifest.layers:
+        with store.layers.open(desc.digest.hex()) as f:
+            data = gzip.decompress(f.read())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+            for m in tf:
+                if m.isreg():
+                    contents[m.name] = tf.extractfile(m).read()
+    return contents
+
+
+def test_copy_heredoc_end_to_end(tmp_path):
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        "ENV MODE=prod\n"
+        "COPY <<config.ini /etc/app/\n"
+        "mode=${MODE}\n"
+        "config.ini\n")
+    assert contents["etc/app/config.ini"] == b"mode=prod\n"
+
+
+def test_copy_heredoc_renames_onto_file_dst(tmp_path):
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        "COPY <<EOF /robots.txt\n"
+        "User-agent: *\n"
+        "EOF\n")
+    assert contents["robots.txt"] == b"User-agent: *\n"
+
+
+def test_copy_mixed_real_and_heredoc_sources(tmp_path):
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        "COPY real.txt <<gen.txt /data/\n"
+        "generated\n"
+        "gen.txt\n",
+        ctx_files={"real.txt": "from context\n"})
+    assert contents["data/real.txt"] == b"from context\n"
+    assert contents["data/gen.txt"] == b"generated\n"
+
+
+def test_copy_heredoc_cache_id_tracks_content(tmp_path):
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.steps.add_copy import CopyStep
+    from makisu_tpu.storage import ImageStore
+
+    root = tmp_path / "root"
+    root.mkdir()
+    (tmp_path / "ctx").mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(tmp_path / "ctx"), store,
+                       sync_wait=0.0)
+    a = CopyStep("<<E /f", "", "", [], "/f", False, False, [("E", "v1\n")])
+    b = CopyStep("<<E /f", "", "", [], "/f", False, False, [("E", "v2\n")])
+    a.set_cache_id(ctx, "seed")
+    b.set_cache_id(ctx, "seed")
+    assert a.cache_id != b.cache_id
+
+
+def test_heredoc_as_destination_rejected():
+    with pytest.raises(ValueError, match="cannot be the destination"):
+        parse_file("FROM scratch\n"
+                   "COPY a.txt <<EOF\n"
+                   "body\n"
+                   "EOF\n")
+
+
+def test_heredoc_invalid_filename_rejected():
+    with pytest.raises(ValueError, match="invalid heredoc file name"):
+        parse_file("FROM scratch\n"
+                   "COPY <<.. /x/\n"
+                   "y\n"
+                   "..\n")
+
+
+def test_inline_cache_id_partition_collision_framed(tmp_path):
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.steps.add_copy import CopyStep
+    from makisu_tpu.storage import ImageStore
+
+    (tmp_path / "ctx").mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(tmp_path), str(tmp_path / "ctx"), store,
+                       sync_wait=0.0)
+    # Same concatenation of names+contents, different partitions.
+    a = CopyStep("x", "", "", [], "/d/", False, False,
+                 [("E", "a\n"), ("F", "b\nFc\n")])
+    b = CopyStep("x", "", "", [], "/d/", False, False,
+                 [("E", "a\nFb\n"), ("F", "c\n")])
+    a.set_cache_id(ctx, "s")
+    b.set_cache_id(ctx, "s")
+    assert a.cache_id != b.cache_id
+
+
+def test_source_order_real_after_inline_wins(tmp_path):
+    from makisu_tpu.builder import BuildPlan
+    from makisu_tpu.cache import NoopCacheManager
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.storage import ImageStore
+
+    root = tmp_path / "root"
+    root.mkdir()
+    ctx_dir = tmp_path / "ctx"
+    (ctx_dir / "sub").mkdir(parents=True)
+    (ctx_dir / "sub" / "f.txt").write_text("from context\n")
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    stages = parse_file("FROM scratch\n"
+                        "COPY <<f.txt sub/f.txt /d/\n"
+                        "from heredoc\n"
+                        "f.txt\n")
+    plan = BuildPlan(ctx, ImageName("", "t/ord", "latest"), [],
+                     NoopCacheManager(), stages, allow_modify_fs=True,
+                     force_commit=False)
+    manifest = plan.execute()
+    import gzip
+    import io
+    import tarfile
+    contents = {}
+    for desc in manifest.layers:
+        with store.layers.open(desc.digest.hex()) as f:
+            data = gzip.decompress(f.read())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
+            for m in tf:
+                if m.isreg():
+                    contents[m.name] = tf.extractfile(m).read()
+    assert contents["d/f.txt"] == b"from context\n"
