@@ -50,7 +50,7 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Stage names in child execution order; the parent reports the deepest
 # one whose line it saw. Keep in sync with _child_main.
-_STAGES = ("start", "import", "backend", "tiny", "big", "ab")
+_STAGES = ("start", "import", "backend", "tiny", "big", "prod", "ab")
 
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
@@ -137,6 +137,50 @@ def _measure_hasher(batch: int, block_bytes: int, lanes: int,
     return _device_loop_gbps(
         loop, (blocks, lanes_arr, lengths),
         batch * block_bytes + lanes * lane_cap, iters)
+
+
+def _prod_shape_gbps() -> dict:
+    """Single-session production shapes (chunker/cdc.py): gear over one
+    [1, 128+4MiB] stream block, SHA over one [512, 16KiB] lane bucket —
+    both device-loop timed. The ratio to the batched bench shapes is
+    the measured value of cross-build batching (worker HashService)."""
+    import jax
+    import jax.numpy as jnp
+
+    from makisu_tpu.ops import gear, sha256
+
+    rng = np.random.default_rng(3)
+    out: dict = {}
+
+    stream = jax.device_put(rng.integers(
+        0, 256, size=(1, 128 + 4 * 1024 * 1024), dtype=np.uint8))
+
+    @jax.jit
+    def gear_loop(data, k):
+        def body(i, acc):
+            w = gear.gear_bitmap(data ^ i.astype(jnp.uint8))
+            return acc + w.sum(dtype=jnp.uint32)
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    g, _ = _device_loop_gbps(gear_loop, (stream,), stream.size, 20)
+    if g is not None:
+        out["prod_gear_gbps"] = round(g, 3)
+
+    lanes = jax.device_put(rng.integers(
+        0, 256, size=(512, 16 * 1024), dtype=np.uint8))
+    lens = jax.device_put(np.full((512,), 16 * 1024 - 64, dtype=np.int32))
+
+    @jax.jit
+    def sha_loop(lanes, lens, k):
+        def body(i, acc):
+            d = sha256.sha256_lanes_impl(lanes ^ i.astype(jnp.uint8), lens)
+            return acc + d.sum(dtype=jnp.uint32)
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    s, _ = _device_loop_gbps(sha_loop, (lanes, lens), lanes.size, 20)
+    if s is not None:
+        out["prod_sha_gbps"] = round(s, 3)
+    return out
 
 
 def _gear_ab_gbps() -> dict:
@@ -249,6 +293,15 @@ def _child_main() -> int:
               compile_secs=round(compile_s, 1))
 
     if backend != "cpu":
+        # Production shapes: what ONE ChunkSession actually dispatches
+        # (a single 4MiB+halo gear stream; a 512-lane 16KiB sha bucket,
+        # chunker/cdc.py BLOCK and _BUCKETS) — quantifies how far the
+        # per-build shapes sit from the batched bench shapes, i.e. the
+        # headroom worker-mode shared batching recovers.
+        try:
+            _emit("prod", **_prod_shape_gbps())
+        except Exception as e:  # noqa: BLE001 - informational stage
+            _emit("prod", prod_error=str(e)[:300])
         try:
             _emit("ab", **_gear_ab_gbps())
         except Exception as e:  # noqa: BLE001 - A/B is best-effort
@@ -392,7 +445,8 @@ def main() -> int:
     for extra in ("tiny_gbps", "tiny_timing_invalid", "big_timing_invalid",
                   "init_secs", "compile_secs",
                   "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
-                  "pallas_error", "sha_block_unroll_sweep",
+                  "pallas_error", "prod_gear_gbps", "prod_sha_gbps",
+                  "prod_error", "sha_block_unroll_sweep",
                   "gear_scan_block_sweep", "device_attempt",
                   "jax_platforms_env", "device_kind"):
         if extra in result:

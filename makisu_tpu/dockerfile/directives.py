@@ -456,12 +456,19 @@ def _take_inline_files(
     inline: list[tuple[str, str]] = []
     ordered: list[tuple[str, str]] = []
     seen: set[str] = set()
+    from makisu_tpu.dockerfile.text import heredoc_tokens
     for src in srcs:
         if not src.startswith("<<"):
             real.append(src)
-            ordered.append(("src", src))
+            # Quote-stripped like AddCopyStep.srcs: execute() resolves
+            # ordered entries directly, so they must match.
+            ordered.append(("src", src.strip("\"'")))
             continue
-        name = src.lstrip("<").lstrip("-").strip("\"'")
+        toks = heredoc_tokens(src)
+        if len(toks) != 1 or toks[0][3] != (0, len(src)):
+            raise ParseError(directive, src,
+                             f"malformed heredoc source {src!r}")
+        name = toks[0][0]
         if name in (".", ".."):
             raise ParseError(directive, src,
                              f"invalid heredoc file name {name!r}")

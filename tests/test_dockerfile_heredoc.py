@@ -246,7 +246,9 @@ def _build_layers(tmp_path, dockerfile, ctx_files=None):
     ctx_dir = tmp_path / "ctx"
     ctx_dir.mkdir()
     for name, content in (ctx_files or {}).items():
-        (ctx_dir / name).write_text(content)
+        path = ctx_dir / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content)
     store = ImageStore(str(tmp_path / "store"))
     ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
     stages = parse_file(dockerfile)
@@ -355,36 +357,35 @@ def test_inline_cache_id_partition_collision_framed(tmp_path):
 
 
 def test_source_order_real_after_inline_wins(tmp_path):
-    from makisu_tpu.builder import BuildPlan
-    from makisu_tpu.cache import NoopCacheManager
-    from makisu_tpu.context import BuildContext
-    from makisu_tpu.docker.image import ImageName
-    from makisu_tpu.storage import ImageStore
-
-    root = tmp_path / "root"
-    root.mkdir()
-    ctx_dir = tmp_path / "ctx"
-    (ctx_dir / "sub").mkdir(parents=True)
-    (ctx_dir / "sub" / "f.txt").write_text("from context\n")
-    store = ImageStore(str(tmp_path / "store"))
-    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
-    stages = parse_file("FROM scratch\n"
-                        "COPY <<f.txt sub/f.txt /d/\n"
-                        "from heredoc\n"
-                        "f.txt\n")
-    plan = BuildPlan(ctx, ImageName("", "t/ord", "latest"), [],
-                     NoopCacheManager(), stages, allow_modify_fs=True,
-                     force_commit=False)
-    manifest = plan.execute()
-    import gzip
-    import io
-    import tarfile
-    contents = {}
-    for desc in manifest.layers:
-        with store.layers.open(desc.digest.hex()) as f:
-            data = gzip.decompress(f.read())
-        with tarfile.open(fileobj=io.BytesIO(data), mode="r|") as tf:
-            for m in tf:
-                if m.isreg():
-                    contents[m.name] = tf.extractfile(m).read()
+    # docker applies sources left to right: the real file named LAST
+    # must overwrite the inline heredoc's same-named file.
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        "COPY <<f.txt sub/f.txt /d/\n"
+        "from heredoc\n"
+        "f.txt\n",
+        ctx_files={"sub/f.txt": "from context\n"})
     assert contents["d/f.txt"] == b"from context\n"
+
+
+def test_quoted_real_source_still_resolves(tmp_path):
+    # Regression: ordered sources must be quote-stripped like srcs.
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        'COPY "a.txt" /d/\n',
+        ctx_files={"a.txt": "quoted ok\n"})
+    assert contents["d/a.txt"] == b"quoted ok\n"
+
+
+def test_dash_leading_heredoc_filename(tmp_path):
+    # <<-NAME means tab-strip + delimiter NAME (shell semantics), so a
+    # dash-leading file name takes a double dash: <<--env -> '-env'.
+    contents = _build_layers(
+        tmp_path,
+        "FROM scratch\n"
+        "COPY <<--env /etc/\n"
+        "K=V\n"
+        "-env\n")
+    assert contents["etc/-env"] == b"K=V\n"
