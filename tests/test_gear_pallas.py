@@ -64,6 +64,56 @@ def test_stage_rows_shapes():
         buf[gear_pallas.ROW - gear_pallas.HALO:gear_pallas.ROW])
 
 
+@pytest.mark.parametrize("start,live", [(0, 1000), (0, 8192),
+                                        (128, 3 * 8192 + 777),
+                                        (50, 9000)])
+def test_gear_bitmap_flat_matches_staged_rows(start, live):
+    """The fused on-device restage must cut exactly where the numpy
+    stage_rows path does (production vs test-oracle staging)."""
+    rng = np.random.default_rng(start + live)
+    buf = rng.integers(0, 256, size=start + live, dtype=np.uint8)
+    words = np.asarray(gear_pallas.gear_bitmap_flat(
+        gear_pallas.quantize_flat(buf, start, live), start,
+        interpret=True))
+    nrows = gear_pallas.nrows_for(live)
+    got = gear.unpack_bits_np(
+        words[:nrows], nrows * gear_pallas.ROW).reshape(-1)[:live]
+    rows, nr = gear_pallas.stage_rows(buf, start, live)
+    w2 = np.asarray(gear_pallas.gear_bitmap_rows(rows, interpret=True))
+    want = gear.unpack_bits_np(
+        w2[:nr], nr * gear_pallas.ROW).reshape(-1)[:live]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunk_session_falls_back_to_xla_on_kernel_failure(monkeypatch):
+    """A Pallas failure must downgrade to the XLA gear path (identical
+    chunks), not degrade fingerprinting."""
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    payload = np.random.default_rng(11).integers(
+        0, 256, size=400_000, dtype=np.uint8).tobytes()
+
+    def run():
+        s = ChunkSession(block=128 * 1024)
+        s.update(payload)
+        return [(c.offset, c.length, c.digest) for c in s.finish()]
+
+    baseline = run()
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic Mosaic rejection")
+
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setattr(gear_pallas, "gear_bitmap_flat", boom)
+    monkeypatch.setattr(gear_pallas, "_broken", False)
+    try:
+        assert run() == baseline          # XLA fallback, same cuts
+        assert gear_pallas._broken        # and the route is disabled
+        assert not gear_pallas.pallas_enabled()
+    finally:
+        gear_pallas._broken = False
+
+
 def test_chunk_session_pallas_path_matches(monkeypatch):
     """MAKISU_TPU_PALLAS=1 must produce identical chunks end to end."""
     from makisu_tpu.chunker.cdc import ChunkSession
