@@ -257,6 +257,63 @@ def _gear_ab_gbps() -> dict:
     return out
 
 
+def _sha_ab_gbps() -> dict:
+    """SHA-256 lane A/B: the XLA SSA scan path vs the Pallas compression
+    kernel, same 4096x16KiB lanes, device-loop timed. Each leg guarded
+    separately so one failure never erases the other's number."""
+    import jax
+    import jax.numpy as jnp
+
+    from makisu_tpu.ops import sha256, sha256_pallas
+
+    rng = np.random.default_rng(4)
+    lanes = jax.device_put(rng.integers(
+        0, 256, size=(4096, 16 * 1024), dtype=np.uint8))
+    lens = jax.device_put(np.full((4096,), 16 * 1024 - 64,
+                                  dtype=np.int32))
+    nbytes = 4096 * 16 * 1024
+    out: dict = {}
+
+    @jax.jit
+    def xla_loop(lanes, lens, k):
+        def body(i, acc):
+            d = sha256.sha256_lanes_impl(lanes ^ i.astype(jnp.uint8),
+                                         lens)
+            return acc + d.sum(dtype=jnp.uint32)
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    try:
+        xla, _ = _device_loop_gbps(xla_loop, (lanes, lens), nbytes, 150)
+        if xla is not None:
+            out["sha_xla_gbps"] = round(xla, 3)
+    except Exception as e:  # noqa: BLE001
+        out["sha_xla_error"] = str(e)[:300]
+
+    @jax.jit
+    def pallas_loop(lanes, lens, k):
+        def body(i, acc):
+            d = sha256_pallas.sha256_lanes_pallas(
+                lanes ^ i.astype(jnp.uint8), lens)
+            return acc + d.sum(dtype=jnp.uint32)
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    try:
+        # Digest parity on device first: the A/B number is meaningless
+        # if the kernel's digests differ.
+        want = np.asarray(sha256.sha256_lanes(lanes, lens))
+        got = np.asarray(sha256_pallas.sha256_lanes_pallas(lanes, lens))
+        if not np.array_equal(want, got):
+            out["sha_pallas_error"] = "digest mismatch vs XLA path"
+            return out
+        pallas, _ = _device_loop_gbps(pallas_loop, (lanes, lens),
+                                      nbytes, 150)
+        if pallas is not None:
+            out["sha_pallas_gbps"] = round(pallas, 3)
+    except Exception as e:  # noqa: BLE001
+        out["sha_pallas_error"] = str(e)[:300]
+    return out
+
+
 def _child_main() -> int:
     """Subprocess entry: staged measurement on whatever backend JAX
     initializes. Every stage line is flushed BEFORE the next stage
@@ -327,9 +384,14 @@ def _child_main() -> int:
         except Exception as e:  # noqa: BLE001 - informational stage
             _emit("prod", prod_error=str(e)[:300])
         try:
-            _emit("ab", **_gear_ab_gbps())
+            ab = _gear_ab_gbps()
         except Exception as e:  # noqa: BLE001 - A/B is best-effort
-            _emit("ab", pallas_error=str(e)[:300])
+            ab = {"pallas_error": str(e)[:300]}
+        try:
+            ab.update(_sha_ab_gbps())
+        except Exception as e:  # noqa: BLE001 - A/B is best-effort
+            ab["sha_pallas_error"] = str(e)[:300]
+        _emit("ab", **ab)
     return 0
 
 
@@ -444,8 +506,12 @@ def main() -> int:
 
         result["sha_block_unroll_sweep"] = sweep_children(
             "MAKISU_TPU_SHA_BLOCK_UNROLL", ("1", "8"))
-        result["gear_scan_block_sweep"] = sweep_children(
-            "MAKISU_TPU_GEAR_SCAN_BLOCK", ("131072", "262144"))
+        # With the Pallas gear kernel the default route, the XLA
+        # scan-block knob no longer moves the headline; instead record
+        # the kernels-off headline so the pallas delta stays visible
+        # round over round.
+        result["pallas_off_sweep"] = sweep_children(
+            "MAKISU_TPU_PALLAS", ("0",))
 
     # Headline value: the big-shape number if it was measured, else the
     # tiny-shape device number (better a small-shape device datapoint
@@ -469,10 +535,12 @@ def main() -> int:
     for extra in ("tiny_gbps", "tiny_timing_invalid", "big_timing_invalid",
                   "init_secs", "compile_secs",
                   "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
+                  "sha_xla_gbps", "sha_pallas_gbps", "sha_xla_error",
+                  "sha_pallas_error",
                   "pallas_error", "prod_gear_route", "prod_gear_gbps",
                   "prod_sha_gbps",
                   "prod_error", "sha_block_unroll_sweep",
-                  "gear_scan_block_sweep", "device_attempt",
+                  "pallas_off_sweep", "device_attempt",
                   "jax_platforms_env", "device_kind"):
         if extra in result:
             record[extra] = result[extra]

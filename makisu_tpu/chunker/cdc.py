@@ -86,7 +86,8 @@ class _LaneBatcher:
     def flush(self) -> None:
         if not self.meta:
             return
-        digests = sha256.sha256_lanes(
+        from makisu_tpu.ops import sha256_pallas
+        digests = sha256_pallas.sha256_lanes_auto(
             self.data, self.lengths)  # async dispatch
         self.pending.append((digests, self.meta))
         self.meta = []

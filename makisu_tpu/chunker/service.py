@@ -93,8 +93,9 @@ class HashService:
             lengths[i] = len(chunk)
         try:
             from makisu_tpu.ops import backend as _backend
+            from makisu_tpu.ops import sha256_pallas
             words = _backend.sync_bounded(
-                sha256.sha256_lanes(data, lengths),
+                sha256_pallas.sha256_lanes_auto(data, lengths),
                 "shared-service digest readback")
         except BaseException as e:  # noqa: BLE001
             for _, fut, _ in batch:

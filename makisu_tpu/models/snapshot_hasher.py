@@ -29,6 +29,15 @@ class SnapshotHasher:
     batch: int = 8                  # streams scanned per step
     lanes: int = 1024               # chunk lanes hashed per step
     lane_cap: int = 16 * 1024       # bytes per lane buffer
+    # Gear route: None = auto (the fused Pallas kernel on TPU backends,
+    # matching the production chunker's default; XLA elsewhere). The
+    # driver's compile gate (__graft_entry__.entry) pins False so a
+    # Mosaic regression can never fail the single-chip compile check.
+    # SHA stays on the XLA SSA path inside this jitted model until the
+    # sha256_pallas kernel has device-validated digests (a jitted
+    # forward cannot run the per-process parity probe the production
+    # dispatch requires — chunk digests are cache identity).
+    use_pallas: bool | None = None
 
     def example_inputs(self) -> tuple[jax.Array, jax.Array, jax.Array]:
         blocks = jnp.zeros((self.batch, self.block_bytes), jnp.uint8)
@@ -40,12 +49,25 @@ class SnapshotHasher:
                 lengths: jax.Array) -> tuple[jax.Array, jax.Array]:
         """One hash step: gear candidate bitmaps + per-lane digests.
 
-        gear_bitmap routes these block sizes (1-4MiB = SCAN_BLOCK
-        multiples, no remainder) through the bandwidth-lean scan path —
-        intermediates stay VMEM-sized instead of materializing ~40
-        bytes of HBM traffic per input byte (bit-identical either
-        way)."""
-        bitmap = gear.gear_bitmap(blocks, self.avg_bits)
+        The gear scan rides the fused Pallas kernel on TPU (see
+        use_pallas); the XLA gear_bitmap routes these block sizes
+        (1-4MiB = SCAN_BLOCK multiples, no remainder) through the
+        bandwidth-lean scan path — intermediates stay VMEM-sized
+        instead of materializing ~40 bytes of HBM traffic per input
+        byte (bit-identical either way)."""
+        from makisu_tpu.ops import gear_pallas
+
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            use_pallas = (gear_pallas.pallas_enabled()
+                          and jax.default_backend() != "cpu"
+                          and self.block_bytes
+                          % (gear_pallas.ROW_TILE * gear_pallas.ROW)
+                          == 0)
+        if use_pallas:
+            bitmap = gear_pallas.gear_bitmap_batch(blocks, self.avg_bits)
+        else:
+            bitmap = gear.gear_bitmap(blocks, self.avg_bits)
         digests = sha256.sha256_lanes(lanes, lengths)
         return bitmap, digests
 

@@ -67,29 +67,33 @@ _HCOLS = HALO // 32   # halo columns in the sublane-major tile
 _CCOLS = ROW // 32    # live columns (= packed words per row)
 
 
-# Set on the first kernel failure (e.g. a Mosaic rejection on a future
-# libtpu): the chunker falls back to the XLA path for the rest of the
-# process instead of degrading chunk fingerprinting entirely.
+# Set on the first GEAR kernel failure (e.g. a Mosaic rejection on a
+# future libtpu): the chunker falls back to the XLA path for the rest
+# of the process instead of degrading chunk fingerprinting entirely.
+# The SHA kernel keeps its own breaker (sha256_pallas) — one kernel's
+# failure must not tax the other's measured win.
 _broken = False
 
 
-def pallas_enabled() -> bool:
-    """Route gear scans through the fused kernel?
-
-    Unset: yes on TPU backends (measured 3.4× the XLA path on v5e),
-    no elsewhere (interpret mode exists for tests, not production).
-    MAKISU_TPU_PALLAS=1/0 forces either way.
-    """
-    if _broken:
-        return False
+def env_enabled() -> bool:
+    """The shared route gate (env override + backend), WITHOUT any
+    kernel's breaker: yes on TPU backends, no elsewhere (interpret mode
+    exists for tests, not production); MAKISU_TPU_PALLAS=1/0 forces
+    both kernels either way."""
     env = os.environ.get("MAKISU_TPU_PALLAS", "")
     if env in ("0", "1"):
         return env == "1"
     return jax.default_backend() == "tpu"
 
 
+def pallas_enabled() -> bool:
+    """Route gear scans through the fused kernel? (Measured 3.4× the
+    XLA path on v5e.)"""
+    return not _broken and env_enabled()
+
+
 def mark_broken(exc: Exception) -> None:
-    """Record a kernel failure and disable the Pallas route (XLA
+    """Record a gear-kernel failure and disable its Pallas route (XLA
     fallback) for the rest of the process."""
     global _broken
     from makisu_tpu.utils import logging as log
@@ -249,6 +253,32 @@ def gear_bitmap_flat(buf: jax.Array, start: int,
     rows = (jnp.concatenate([halos, live_m], axis=1)
             .reshape(R, _HCOLS + _CCOLS, 32).transpose(0, 2, 1))
     return _invoke_kernel(rows, avg_bits, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))
+def gear_bitmap_batch(blocks: jax.Array,
+                      avg_bits: int = gear.DEFAULT_AVG_BITS,
+                      interpret: bool = False) -> jax.Array:
+    """Batched kernel route for [B, N] stream blocks (N a multiple of
+    ROW_TILE*ROW), zero history per stream — the SnapshotHasher shape.
+
+    Returns packed words [B, N//32]. NOTE: positions < WINDOW differ
+    from gear.gear_bitmap's zero-G-value head (the kernel's halo is
+    zero BYTES, G(0) != 0); both sit far below the minimum chunk size
+    and never become cuts — same caveat as every kernel path.
+    """
+    B, n = blocks.shape
+    if n % (ROW_TILE * ROW):
+        raise ValueError(f"block bytes {n} not a multiple of "
+                         f"{ROW_TILE * ROW}")
+    R = n // ROW
+    live_m = blocks.reshape(B, R, ROW)
+    halos = jnp.pad(live_m[:, :-1, ROW - HALO:],
+                    ((0, 0), (1, 0), (0, 0)))   # stream head: zero halo
+    rows = (jnp.concatenate([halos, live_m], axis=2)
+            .reshape(B * R, _HCOLS + _CCOLS, 32).transpose(0, 2, 1))
+    words = _invoke_kernel(rows, avg_bits, interpret)
+    return words.reshape(B, R * _CCOLS)
 
 
 def gear_candidates(buf: np.ndarray, start: int, n: int,

@@ -174,6 +174,23 @@ def _round(a, b, c, d, e, f, g, h, k, wt):
     return t1 + s0 + maj, a, b, c, d + t1, e, f, g
 
 
+def _schedule_rounds16(v, W, ks):
+    """One 16-round schedule group: rotates the 16-word message window
+    exactly once by SSA renaming (W mutated in place) and applies 16
+    rounds. ``ks`` is any indexable of 16 uint32 round constants. THE
+    shared definition for the XLA scan (_compress) and the Pallas
+    kernel (ops/sha256_pallas.py) — the round math must never fork."""
+    for r in range(16):
+        w15 = W[(r + 1) % 16]
+        w2 = W[(r + 14) % 16]
+        s0w = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+        s1w = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+        wt = W[r] + s0w + W[(r + 9) % 16] + s1w
+        W[r] = wt
+        v = _round(*v, ks[r], wt)
+    return v
+
+
 def _compress(state, w16):
     """One SHA-256 block over all lanes. state: [8, L]; w16: [16, L].
 
@@ -191,14 +208,7 @@ def _compress(state, w16):
     def sixteen(carry, ks):
         v, W = carry
         W = list(W)
-        for r in range(16):
-            w15 = W[(r + 1) % 16]
-            w2 = W[(r + 14) % 16]
-            s0w = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
-            s1w = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
-            wt = W[r] + s0w + W[(r + 9) % 16] + s1w
-            W[r] = wt
-            v = _round(*v, ks[r], wt)
+        v = _schedule_rounds16(v, W, ks)
         return (v, tuple(W)), None
 
     ks = jnp.asarray(_K[16:]).reshape(3, 16)

@@ -1,0 +1,198 @@
+"""Pallas TPU kernel for lane-parallel SHA-256 compression.
+
+The XLA path (ops/sha256.py sha256_lanes_impl) is SSA-formulated and
+already fast (24 GB/s on 4096x16KiB lanes, v5e), but every block step
+pays XLA overhead the compression math doesn't need: a [L,64]->[16,L]
+tile transpose, dynamic-slice reads, and masking selects threaded
+through the scan carry. This kernel does the block chain as pure
+elementwise u32 VPU work on [TILE_L]-lane vectors with the hash state
+resident in VMEM across the whole block grid:
+
+- XLA pre-pass (same jit): padding (the shared _apply_padding formula),
+  byteswap to big-endian words, ONE transpose to block-major
+  [NB, 16, L] so each grid step's 16 schedule words are contiguous
+  sublane slices.
+- Kernel grid (lane_tiles, NB): the block axis iterates sequentially
+  (TPU grid order) revisiting the same output tile, so the chaining
+  state never leaves VMEM; rounds 0-63 are fully unrolled Python-side —
+  the schedule window is 16 SSA variables rotated by renaming, exactly
+  the formulation the XLA path uses (ops/sha256.py _compress).
+- Ragged lanes: per-lane live-block counts ship as an i32 input; a
+  lane's state stops updating at its block count (vector select), so
+  digests are bit-identical to the XLA path for any length mix.
+
+SHA-256 needs no reductions — the one Mosaic feature class the gear
+kernel had to design around (gear_pallas.py docstring) — so the whole
+kernel is elementwise add/xor/and/not/shift on u32, all natively
+supported.
+
+Status: shares the gear kernel's env/backend gate but keeps its own
+breaker, and production dispatch (sha256_lanes_auto) additionally
+requires a one-time per-process parity probe against hashlib at the
+production bucket shape — this kernel reached 2026-07-29's tunnel wedge
+before device validation, and chunk digests are cache identity, so it
+must prove itself on every process before being trusted. bench.py's
+_sha_ab_gbps records the device A/B (with a digest-parity assert) the
+next time a driver run finds the tunnel alive.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from makisu_tpu.ops import sha256
+
+TILE_L = 1024  # lanes per grid step: [1024] u32 = one (8,128) vector tile
+
+
+def _sha_kernel(wt_ref, nb_ref, out_ref) -> None:
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _():
+        # Array constants can't be captured by a pallas kernel; build
+        # the IV from scalar constants row by row.
+        for i in range(8):
+            out_ref[i, :] = jnp.full(
+                (out_ref.shape[1],), int(sha256._H0[i]), jnp.uint32)
+
+    state = out_ref[:]                        # [8, TL]
+    v = tuple(state[i] for i in range(8))
+    W = [wt_ref[0, j, :] for j in range(16)]  # 16 x [TL]
+    for t in range(16):
+        v = sha256._round(*v, jnp.uint32(int(sha256._K[t])), W[t])
+    for g in range(3):                        # rounds 16-63, shared math
+        ks = [jnp.uint32(int(sha256._K[16 + 16 * g + r]))
+              for r in range(16)]
+        v = sha256._schedule_rounds16(v, W, ks)
+    new = state + jnp.stack(v)
+    keep = (b < nb_ref[:])[None, :]
+    out_ref[:] = jnp.where(keep, new, state)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sha256_lanes_pallas(data: jax.Array, lengths: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """Ragged uint8 lanes [L, CAP] + lengths [L] -> [L, 8] digests.
+
+    Drop-in for sha256.sha256_lanes (no init_state: the sharded pcast-IV
+    path keeps the XLA impl). L is padded to TILE_L internally.
+    """
+    from jax.experimental import pallas as pl
+
+    L, cap = data.shape
+    if cap % 64:
+        raise ValueError(f"lane capacity {cap} not a multiple of 64")
+    lengths = lengths.astype(jnp.int32)
+    tl = min(TILE_L, L) if L % TILE_L else TILE_L
+    if L % tl:
+        pad = tl - L % tl
+        data = jnp.pad(data, ((0, pad), (0, 0)))
+        lengths = jnp.pad(lengths, (0, pad))  # nb=1 for len 0; harmless
+        Lp = L + pad
+    else:
+        Lp = L
+    nb = sha256.num_blocks(lengths)
+    padded = sha256.pad_lanes(data, lengths)
+    words = sha256.bytes_to_words(padded)         # [Lp, NB, 16]
+    wt = jnp.transpose(words, (1, 2, 0))          # [NB, 16, Lp]
+    NB = cap // 64
+    state = pl.pallas_call(
+        _sha_kernel,
+        grid=(Lp // tl, NB),
+        in_specs=[
+            pl.BlockSpec((1, 16, tl), lambda l, b: (b, 0, l)),
+            pl.BlockSpec((tl,), lambda l, b: (l,)),
+        ],
+        out_specs=pl.BlockSpec((8, tl), lambda l, b: (0, l)),
+        out_shape=jax.ShapeDtypeStruct((8, Lp), jnp.uint32),
+        interpret=interpret,
+    )(wt, nb)
+    return jnp.transpose(state)[:L]
+
+
+# This kernel's OWN breaker (a SHA failure must never disable the
+# device-validated gear kernel) and the one-time per-process device
+# parity verdict (None = not yet probed).
+_broken = False
+_parity_ok: bool | None = None
+
+
+def mark_broken(exc: Exception) -> None:
+    global _broken
+    from makisu_tpu.utils import logging as log
+    _broken = True
+    log.warning("pallas sha256 kernel disabled for this process "
+                "(falling back to the XLA path): %s", str(exc)[:300])
+
+
+def _device_parity_ok() -> bool:
+    """Probe the kernel ONCE per process against hashlib ground truth
+    on the live backend before trusting it with production digests.
+
+    Chunk digests are cache identity (cache/chunks.py): a kernel that
+    compiled but produced wrong bytes on some future libtpu would
+    silently split identity between TPU and CPU builders. The probe
+    runs the PRODUCTION bucket shape (512 lanes x 16 KiB — the first
+    _BUCKETS entry, so the probe's compile is exactly the program the
+    first real flush reuses) over ragged lengths covering the padding
+    edges, compares with hashlib, and pins the process to the XLA path
+    on any mismatch or failure. The readback is bounded: a wedged
+    tunnel must degrade the probe, never hang the build
+    (ops/backend.py sync discipline)."""
+    global _parity_ok
+    if _parity_ok is None:
+        import hashlib
+
+        from makisu_tpu.ops import backend as _backend
+
+        rng = np.random.default_rng(0xEC0)
+        data = rng.integers(0, 256, size=(512, 16 * 1024),
+                            dtype=np.uint8)
+        lengths = rng.integers(0, 16 * 1024 - 9, size=512).astype(
+            np.int32)
+        lengths[:8] = (0, 1, 55, 56, 63, 64, 100, 16 * 1024 - 9)
+        try:
+            got = _backend.sync_bounded(
+                sha256_lanes_pallas(data, lengths),
+                "sha256 pallas parity probe")
+            _parity_ok = all(
+                got[i].astype(">u4").tobytes()
+                == hashlib.sha256(data[i, :lengths[i]].tobytes()).digest()
+                for i in range(512))
+            if not _parity_ok:
+                mark_broken(
+                    RuntimeError("parity probe: digest mismatch vs "
+                                 "hashlib"))
+        except Exception as e:  # noqa: BLE001 - kernel plane
+            mark_broken(e)
+            _parity_ok = False
+    return _parity_ok
+
+
+def sha256_lanes_auto(data, lengths):
+    """The production dispatch: Pallas kernel when enabled (TPU
+    backends; shared env gate with the gear kernel, own breaker) and
+    the per-process parity probe passes, XLA path otherwise or on
+    kernel failure. Unlike the gear kernel, interpret mode is NOT used
+    on CPU even under MAKISU_TPU_PALLAS=1: the 64 fully-inlined rounds
+    take XLA:CPU many minutes to compile (observed on a 1-core host),
+    so CPU always rides the scan-based XLA path — digests are
+    bit-identical either way (asserted in tests)."""
+    from makisu_tpu.ops import gear_pallas
+
+    if (not _broken
+            and gear_pallas.env_enabled()
+            and jax.default_backend() != "cpu"
+            and _device_parity_ok()):
+        try:
+            return sha256_lanes_pallas(data, lengths)
+        except Exception as e:  # noqa: BLE001 - kernel plane
+            mark_broken(e)
+    return sha256.sha256_lanes(data, lengths)

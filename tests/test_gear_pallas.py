@@ -114,6 +114,23 @@ def test_chunk_session_falls_back_to_xla_on_kernel_failure(monkeypatch):
         gear_pallas._broken = False
 
 
+def test_gear_bitmap_batch_matches_xla_above_window():
+    """The SnapshotHasher kernel route must select the same candidate
+    positions as the XLA route for every stream in the batch (positions
+    below WINDOW excluded per the zero-halo caveat)."""
+    rng = np.random.default_rng(21)
+    B, n = 3, 2 * gear_pallas.ROW_TILE * gear_pallas.ROW
+    blocks = rng.integers(0, 256, size=(B, n), dtype=np.uint8)
+    got_words = np.asarray(gear_pallas.gear_bitmap_batch(
+        blocks, interpret=True))
+    want_words = np.asarray(gear.gear_bitmap(blocks))
+    for b in range(B):
+        got = np.nonzero(gear.unpack_bits_np(got_words[b], n))[0]
+        want = np.nonzero(gear.unpack_bits_np(want_words[b], n))[0]
+        np.testing.assert_array_equal(got[got >= gear.WINDOW],
+                                      want[want >= gear.WINDOW])
+
+
 def test_chunk_session_pallas_path_matches(monkeypatch):
     """MAKISU_TPU_PALLAS=1 must produce identical chunks end to end."""
     from makisu_tpu.chunker.cdc import ChunkSession

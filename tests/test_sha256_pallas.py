@@ -1,0 +1,137 @@
+"""SHA-256 Pallas kernel: dispatch gating, parity-probe breaker, and
+(opt-in, slow on CPU) interpret-mode correctness.
+
+The kernel's round math is sha256._schedule_rounds16 / _round — the
+exact functions the heavily-tested XLA path runs — so CPU CI focuses on
+the dispatch/breaker logic; bit-level kernel validation runs on device
+(bench.py _sha_ab_gbps asserts digest parity before timing) and via the
+per-process parity probe in production."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from makisu_tpu.ops import gear_pallas, sha256_pallas
+
+
+def _hashlib_digests(data, lengths):
+    import hashlib
+
+    return [hashlib.sha256(data[i, : lengths[i]].tobytes()).digest()
+            for i in range(len(lengths))]
+
+
+@pytest.fixture(autouse=True)
+def _reset_breaker(monkeypatch):
+    # Tests below monkeypatch jax.default_backend() to "tpu", which
+    # would flip sha256's per-backend scan unrolls to the TPU optimum —
+    # a many-minute compile on XLA:CPU. Pin the CPU-safe unrolls.
+    monkeypatch.setenv("MAKISU_TPU_SHA_INNER_UNROLL", "1")
+    monkeypatch.setenv("MAKISU_TPU_SHA_BLOCK_UNROLL", "1")
+    yield
+    gear_pallas._broken = False
+    sha256_pallas._broken = False
+    sha256_pallas._parity_ok = None
+
+
+def test_auto_on_cpu_never_touches_kernel(monkeypatch):
+    """CPU backends ride the XLA path even when pallas is force-enabled
+    (the kernel's unrolled body explodes XLA:CPU compile time)."""
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+
+    def boom(*a, **k):
+        raise AssertionError("kernel dispatched on cpu")
+
+    monkeypatch.setattr(sha256_pallas, "sha256_lanes_pallas", boom)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(16, 256), dtype=np.uint8)
+    lengths = rng.integers(0, 247, size=16).astype(np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(data, lengths))
+    want = _hashlib_digests(data, lengths)
+    assert [g.astype(">u4").tobytes() for g in got] == want
+
+
+def test_parity_probe_mismatch_pins_xla(monkeypatch):
+    """A kernel that compiles but produces wrong digests must trip the
+    breaker before any production digest is computed."""
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def wrong(data, lengths, interpret=False):
+        return np.zeros((data.shape[0], 8), dtype=np.uint32)
+
+    monkeypatch.setattr(sha256_pallas, "sha256_lanes_pallas", wrong)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(8, 256), dtype=np.uint8)
+    lengths = rng.integers(0, 247, size=8).astype(np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(data, lengths))
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        data, lengths)                       # correct XLA digests
+    assert sha256_pallas._broken             # SHA breaker tripped...
+    assert not gear_pallas._broken           # ...gear kernel unaffected
+    assert sha256_pallas._parity_ok is False
+
+
+def test_parity_probe_exception_pins_xla(monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic Mosaic rejection")
+
+    monkeypatch.setattr(sha256_pallas, "sha256_lanes_pallas", boom)
+    data = np.zeros((4, 64), dtype=np.uint8)
+    lengths = np.array([0, 1, 2, 3], dtype=np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(data, lengths))
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        data, lengths)
+    assert sha256_pallas._broken
+    assert not gear_pallas._broken
+
+
+def test_parity_probe_pass_routes_to_kernel(monkeypatch):
+    """When the probe passes, production dispatch uses the kernel."""
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def fake_kernel(data, lengths, interpret=False):
+        import hashlib
+
+        data, lengths = np.asarray(data), np.asarray(lengths)
+        calls.append(data.shape)
+        # Digest-correct by construction (hashlib, not the slow-on-CPU
+        # lane path — the probe shape is the 512x16KiB bucket).
+        out = np.zeros((len(lengths), 8), np.uint32)
+        for i, n in enumerate(lengths):
+            d = hashlib.sha256(data[i, :n].tobytes()).digest()
+            out[i] = np.frombuffer(d, dtype=">u4")
+        return out
+
+    monkeypatch.setattr(sha256_pallas, "sha256_lanes_pallas",
+                        fake_kernel)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(8, 256), dtype=np.uint8)
+    lengths = rng.integers(0, 247, size=8).astype(np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(data, lengths))
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        data, lengths)
+    assert sha256_pallas._parity_ok is True
+    assert len(calls) == 2                   # probe + production call
+
+
+@pytest.mark.skipif(
+    os.environ.get("MAKISU_TPU_SLOW_TESTS") != "1",
+    reason="interpret-mode kernel compile takes minutes on XLA:CPU "
+           "(set MAKISU_TPU_SLOW_TESTS=1; device validation runs in "
+           "bench.py's SHA A/B and the production parity probe)")
+def test_kernel_interpret_matches_hashlib():
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, size=(8, 128), dtype=np.uint8)
+    lengths = np.array([0, 1, 55, 56, 63, 64, 100, 119], dtype=np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_pallas(
+        data, lengths, interpret=True))
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        data, lengths)
