@@ -50,7 +50,8 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Stage names in child execution order; the parent reports the deepest
 # one whose line it saw. Keep in sync with _child_main.
-_STAGES = ("start", "import", "backend", "tiny", "big", "prod", "ab")
+_STAGES = ("start", "import", "backend", "tiny", "big", "prod", "ab",
+           "ab_sha")
 
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
@@ -107,15 +108,36 @@ def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
 
 
 def _measure_hasher(batch: int, block_bytes: int, lanes: int,
-                    lane_cap: int, iters: int) -> tuple[float, float]:
-    """Measure one SnapshotHasher config; returns (gbps, compile_s)."""
+                    lane_cap: int,
+                    iters: int) -> tuple[float | None, float, dict]:
+    """Measure one SnapshotHasher config; returns (gbps, compile_s,
+    extras). The auto route rides the Pallas gear kernel on TPU; a
+    kernel failure (e.g. a future Mosaic rejection) falls back to the
+    XLA route and is recorded in extras instead of killing the child
+    before any number exists."""
+    try:
+        gbps, compile_s = _measure_hasher_route(
+            batch, block_bytes, lanes, lane_cap, iters, None)
+        return gbps, compile_s, {}
+    except Exception as e:  # noqa: BLE001 - kernel plane
+        extras = {"hasher_pallas_error": str(e)[:200]}
+        gbps, compile_s = _measure_hasher_route(
+            batch, block_bytes, lanes, lane_cap, iters, False)
+        return gbps, compile_s, extras
+
+
+def _measure_hasher_route(batch: int, block_bytes: int, lanes: int,
+                          lane_cap: int, iters: int,
+                          use_pallas: bool | None) -> tuple[float | None,
+                                                            float]:
     import jax
     import jax.numpy as jnp
 
     from makisu_tpu.models import SnapshotHasher
 
     hasher = SnapshotHasher(batch=batch, block_bytes=block_bytes,
-                            lanes=lanes, lane_cap=lane_cap)
+                            lanes=lanes, lane_cap=lane_cap,
+                            use_pallas=use_pallas)
     rng = np.random.default_rng(1)
     blocks = jax.device_put(rng.integers(
         0, 256, size=(batch, block_bytes), dtype=np.uint8))
@@ -254,6 +276,34 @@ def _gear_ab_gbps() -> dict:
             out["gear_pallas_gbps"] = round(pallas, 3)
     except Exception as e:  # noqa: BLE001 - best-effort experimental leg
         out["pallas_error"] = str(e)[:300]
+
+    # v2 (natural layout, no restage): parity-check on device, then
+    # time. Guarded separately — v2 is opt-in in production until this
+    # very record exists.
+    try:
+        flat_dev = jax.device_put(buf)  # n is a V2_TILE multiple
+
+        want = np.asarray(gear.gear_bitmap(buf))
+        got = np.asarray(gear_pallas.gear_bitmap_flat2(flat_dev))
+        if not np.array_equal(
+                gear.unpack_bits_np(got, n),
+                gear.unpack_bits_np(want, n)):
+            out["gear_v2_error"] = "bitmap mismatch vs XLA path"
+            return out
+
+        @jax.jit
+        def v2_loop(data, k):
+            def body(i, acc):
+                w = gear_pallas.gear_bitmap_flat2(
+                    data ^ i.astype(jnp.uint8))
+                return acc + w.sum(dtype=jnp.uint32)
+            return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+        v2, _ = _device_loop_gbps(v2_loop, (flat_dev,), n, iters)
+        if v2 is not None:
+            out["gear_v2_gbps"] = round(v2, 3)
+    except Exception as e:  # noqa: BLE001 - best-effort experimental leg
+        out["gear_v2_error"] = str(e)[:300]
     return out
 
 
@@ -344,34 +394,34 @@ def _child_main() -> int:
     # backend yields a device datapoint well inside the budget. (More
     # iterations on a real device so compute beats tunnel jitter; CPU
     # keeps the short loop — it is compute-bound at any length.)
-    tiny_gbps, tiny_compile = _measure_hasher(
+    tiny_gbps, tiny_compile, tiny_extra = _measure_hasher(
         batch=2, block_bytes=1024 * 1024, lanes=256, lane_cap=16 * 1024,
         iters=20 if backend == "cpu" else 150)
     if tiny_gbps is None:
         _emit("tiny", backend=backend, tiny_timing_invalid=True,
-              tiny_compile_secs=round(tiny_compile, 1))
+              tiny_compile_secs=round(tiny_compile, 1), **tiny_extra)
     else:
         _emit("tiny", backend=backend, tiny_gbps=round(tiny_gbps, 3),
-              tiny_compile_secs=round(tiny_compile, 1))
+              tiny_compile_secs=round(tiny_compile, 1), **tiny_extra)
 
     if backend == "cpu":
         # No accelerator: the tiny smoke measurement above already
         # validated the pipeline + output format on these exact shapes;
         # re-measuring would just pay a second compile. The recorded
         # number is meaningless on CPU either way.
-        gbps, compile_s = tiny_gbps, tiny_compile
+        gbps, compile_s, big_extra = tiny_gbps, tiny_compile, {}
     else:
         # One step: gear-scan 24 x 4MiB stream blocks and hash 4096 full
         # 16KiB chunk lanes — 96MiB of gear bytes + 64MiB of sha bytes.
-        gbps, compile_s = _measure_hasher(
+        gbps, compile_s, big_extra = _measure_hasher(
             batch=24, block_bytes=4 * 1024 * 1024, lanes=4096,
             lane_cap=16 * 1024, iters=50)
     if gbps is None:
         _emit("big", backend=backend, big_timing_invalid=True,
-              compile_secs=round(compile_s, 1))
+              compile_secs=round(compile_s, 1), **big_extra)
     else:
         _emit("big", backend=backend, gbps=round(gbps, 3),
-              compile_secs=round(compile_s, 1))
+              compile_secs=round(compile_s, 1), **big_extra)
 
     if backend != "cpu":
         # Production shapes: what ONE ChunkSession actually dispatches
@@ -383,15 +433,18 @@ def _child_main() -> int:
             _emit("prod", **_prod_shape_gbps())
         except Exception as e:  # noqa: BLE001 - informational stage
             _emit("prod", prod_error=str(e)[:300])
+        # Gear A/B flushes BEFORE the SHA A/B starts: a wedge inside
+        # the SHA legs must never erase already-measured gear numbers
+        # (the staged-emission discipline; exactly this data-loss class
+        # happened in the 2026-07 session).
         try:
-            ab = _gear_ab_gbps()
+            _emit("ab", **_gear_ab_gbps())
         except Exception as e:  # noqa: BLE001 - A/B is best-effort
-            ab = {"pallas_error": str(e)[:300]}
+            _emit("ab", pallas_error=str(e)[:300])
         try:
-            ab.update(_sha_ab_gbps())
+            _emit("ab_sha", **_sha_ab_gbps())
         except Exception as e:  # noqa: BLE001 - A/B is best-effort
-            ab["sha_pallas_error"] = str(e)[:300]
-        _emit("ab", **ab)
+            _emit("ab_sha", sha_pallas_error=str(e)[:300])
     return 0
 
 
@@ -535,8 +588,9 @@ def main() -> int:
     for extra in ("tiny_gbps", "tiny_timing_invalid", "big_timing_invalid",
                   "init_secs", "compile_secs",
                   "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
+                  "gear_v2_gbps", "gear_v2_error",
                   "sha_xla_gbps", "sha_pallas_gbps", "sha_xla_error",
-                  "sha_pallas_error",
+                  "sha_pallas_error", "hasher_pallas_error",
                   "pallas_error", "prod_gear_route", "prod_gear_gbps",
                   "prod_sha_gbps",
                   "prod_error", "sha_block_unroll_sweep",

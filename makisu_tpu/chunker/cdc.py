@@ -249,7 +249,26 @@ class ChunkSession:
         halo = self._halo
         buf = np.frombuffer(halo + blk, dtype=np.uint8)
         entry = None
-        if gear_pallas.pallas_enabled():
+        if gear_pallas.v2_enabled():
+            # Opt-in natural-layout kernel (MAKISU_TPU_PALLAS_V2=1):
+            # pure-reshape staging, full-buffer bitmap (XLA-contract
+            # slicing) — see gear_pallas.py v2 block.
+            try:
+                need = ((len(buf) + gear_pallas.V2_TILE - 1)
+                        // gear_pallas.V2_TILE) * gear_pallas.V2_TILE
+                if need != len(buf):
+                    qbuf = np.zeros(need, dtype=np.uint8)
+                    qbuf[:len(buf)] = buf
+                else:
+                    qbuf = buf
+                words = gear_pallas.gear_bitmap_flat2(
+                    qbuf, self.avg_bits,
+                    interpret=jax.default_backend() == "cpu")
+                entry = ("xla", words, len(halo), live, blk,
+                         self._scanned)
+            except Exception as e:  # noqa: BLE001 - kernel plane
+                gear_pallas.mark_broken(e)
+        if entry is None and gear_pallas.pallas_enabled():
             # Fused kernel (default on TPU; 3.4× the XLA path on v5e).
             # Restaging runs on device inside the same program; a kernel
             # failure here (sync: jit compiles at call time) downgrades

@@ -255,6 +255,106 @@ def gear_bitmap_flat(buf: jax.Array, start: int,
     return _invoke_kernel(rows, avg_bits, interpret)
 
 
+# ---------------------------------------------------------------------------
+# v2: natural-layout kernel (no restage transpose).
+#
+# The same per-group factorization works with rows of 128 CONSECUTIVE
+# bytes along the lane axis: h[s, l] = P[s, l] + Q[s-1] * 2^(l+1)
+# (mod 2^32), where P is the log-doubling window scan with pure LANE
+# shifts (zero fill) and Q[s] = P[s, 127] is the row's weighted tail.
+# Contributions older than the 32-byte window self-vanish in the
+# 2^(l+1) factor exactly as in v1 — and since lanes l >= 31 never
+# receive a borrow, the weight is just zeroed there (no >= 32-bit
+# shifts). The input is a PURE RESHAPE of the stream ([R, 128] rows),
+# so the v1 restage transpose — measured to cost half the fused
+# throughput (35 vs 74 GB/s kernel-only, v5e 2026-07-29) — disappears.
+# Cross-tile history rides an SMEM carry across the sequential grid,
+# which also makes v2 bit-identical to gear.gear_hash INCLUDING the
+# zero-history head (no byte-halo approximation at all).
+#
+# Status: interpret-validated; device A/B recorded by bench.py
+# (_gear_ab_gbps) next time a driver run finds the tunnel alive. v1
+# stays the production default until v2 has device numbers.
+
+V2_ROWS = 256                 # sublane rows per grid step (32 KiB live)
+V2_TILE = V2_ROWS * 128       # bytes per grid step
+
+
+def _gear_kernel2(avg_bits: int, rows_ref, out_ref, q_ref) -> None:
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(0)
+    d = rows_ref[:]                            # [V2_ROWS, 128] uint8
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (1, 128), 1)
+
+    def lane_shift(h, m):
+        return jnp.pad(h[:, :128 - m], ((0, 0), (m, 0)))
+
+    p = gear._windowed_sum(gear._gear_value(d), shift=lane_shift)
+    p_i = jax.lax.bitcast_convert_type(p, jnp.int32)
+    qcol = jnp.sum(jnp.where(lane == 127, p_i, 0), axis=1,
+                   keepdims=True, dtype=jnp.int32)   # [V2_ROWS, 1]
+    q_top = jnp.where(j == 0, 0, q_ref[0])
+    q_prev = jnp.pad(qcol[:-1], ((1, 0), (0, 0)))
+    srow = jax.lax.broadcasted_iota(jnp.int32, qcol.shape, 0)
+    q_prev = jax.lax.bitcast_convert_type(
+        jnp.where(srow == 0, q_top, q_prev), jnp.uint32)
+    # weight[l] = 2^(l+1) for l <= 30, else 0 (out-of-window terms).
+    weight = jnp.where(lane <= 30, jnp.uint32(2) << jnp.minimum(
+        lane, jnp.uint32(30)), jnp.uint32(0))
+    h = p + q_prev * weight
+    mask_i = ((h & jnp.uint32((1 << avg_bits) - 1)) == 0).astype(
+        jnp.int32)
+    # Pack: word w of a row covers its lanes [32*(w), 32*w+32); four
+    # masked lane reductions (a lane-split reshape is not lowerable).
+    words = []
+    for k in range(4):
+        sub = (lane >= 32 * k) & (lane < 32 * (k + 1))
+        wbit = jnp.where(sub, mask_i << (lane.astype(jnp.int32)
+                                         - 32 * k), 0)
+        words.append(jnp.sum(wbit, axis=1, keepdims=True,
+                             dtype=jnp.int32))
+    out_ref[:] = jax.lax.bitcast_convert_type(
+        jnp.concatenate(words, axis=1), jnp.uint32)
+    q_ref[0] = qcol[V2_ROWS - 1, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))
+def gear_bitmap_flat2(buf: jax.Array,
+                      avg_bits: int = gear.DEFAULT_AVG_BITS,
+                      interpret: bool = False) -> jax.Array:
+    """Natural-layout kernel over a flat uint8 stream (length a
+    multiple of V2_TILE; callers zero-pad and slice the bitmap).
+    Returns packed words [len(buf)//32], zero-history at position 0 —
+    the exact gear.gear_bitmap contract, including head positions."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = buf.shape[0]
+    if n % V2_TILE:
+        raise ValueError(f"stream length {n} not a multiple of "
+                         f"{V2_TILE}")
+    rows = buf.reshape(n // 128, 128)
+    kernel = functools.partial(_gear_kernel2, avg_bits)
+    words = pl.pallas_call(
+        kernel,
+        grid=(n // V2_TILE,),
+        in_specs=[pl.BlockSpec((V2_ROWS, 128), lambda j: (j, 0))],
+        out_specs=pl.BlockSpec((V2_ROWS, 4), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n // 128, 4), jnp.uint32),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(rows)
+    return words.reshape(-1)
+
+
+def v2_enabled() -> bool:
+    """Opt-in gate for the v2 kernel (MAKISU_TPU_PALLAS_V2=1) until it
+    has device numbers; shares the breaker with v1."""
+    return (os.environ.get("MAKISU_TPU_PALLAS_V2", "") == "1"
+            and pallas_enabled())
+
+
 @functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))
 def gear_bitmap_batch(blocks: jax.Array,
                       avg_bits: int = gear.DEFAULT_AVG_BITS,

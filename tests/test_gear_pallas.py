@@ -114,6 +114,45 @@ def test_chunk_session_falls_back_to_xla_on_kernel_failure(monkeypatch):
         gear_pallas._broken = False
 
 
+@pytest.mark.parametrize("n_live", [1, 100, 33000, 200000])
+def test_gear_bitmap_flat2_identical_to_xla(n_live):
+    """v2 (natural layout + SMEM carry) is bit-identical to
+    gear.gear_hash INCLUDING head positions — no halo approximation."""
+    rng = np.random.default_rng(n_live)
+    need = ((n_live + gear_pallas.V2_TILE - 1)
+            // gear_pallas.V2_TILE) * gear_pallas.V2_TILE
+    buf = np.zeros(need, dtype=np.uint8)
+    buf[:n_live] = rng.integers(0, 256, size=n_live, dtype=np.uint8)
+    words = np.asarray(gear_pallas.gear_bitmap_flat2(
+        buf, interpret=True))
+    got = np.nonzero(gear.unpack_bits_np(words, need)[:n_live])[0]
+    h = np.asarray(gear.gear_hash(buf))[:n_live]
+    want = np.nonzero(
+        (h & ((1 << gear.DEFAULT_AVG_BITS) - 1)) == 0)[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunk_session_v2_path_matches(monkeypatch):
+    """MAKISU_TPU_PALLAS_V2=1 must produce identical chunks end to
+    end (the v2 route slices the full-buffer bitmap like the XLA
+    path)."""
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    payload = np.random.default_rng(77).integers(
+        0, 256, size=500_000, dtype=np.uint8).tobytes()
+
+    def run():
+        s = ChunkSession(block=128 * 1024)
+        for i in range(0, len(payload), 50_000):
+            s.update(payload[i:i + 50_000])
+        return [(c.offset, c.length, c.digest) for c in s.finish()]
+
+    baseline = run()
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setenv("MAKISU_TPU_PALLAS_V2", "1")
+    assert run() == baseline
+
+
 def test_gear_bitmap_batch_matches_xla_above_window():
     """The SnapshotHasher kernel route must select the same candidate
     positions as the XLA route for every stream in the batch (positions
