@@ -267,7 +267,7 @@ class ChunkSession:
                 entry = ("xla", words, len(halo), live, blk,
                          self._scanned)
             except Exception as e:  # noqa: BLE001 - kernel plane
-                gear_pallas.mark_broken(e)
+                gear_pallas.mark_v2_broken(e)
         if entry is None and gear_pallas.pallas_enabled():
             # Fused kernel (default on TPU; 3.4× the XLA path on v5e).
             # Restaging runs on device inside the same program; a kernel

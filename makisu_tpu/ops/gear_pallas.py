@@ -348,11 +348,28 @@ def gear_bitmap_flat2(buf: jax.Array,
     return words.reshape(-1)
 
 
+# v2's OWN breaker (advisor r3): a v2 failure must fall back to the
+# device-validated v1 route, never downgrade the production-default
+# kernel to XLA for the whole process.
+_v2_broken = False
+
+
 def v2_enabled() -> bool:
     """Opt-in gate for the v2 kernel (MAKISU_TPU_PALLAS_V2=1) until it
-    has device numbers; shares the breaker with v1."""
+    has device numbers; own breaker, shared env/backend gate."""
     return (os.environ.get("MAKISU_TPU_PALLAS_V2", "") == "1"
-            and pallas_enabled())
+            and not _v2_broken and env_enabled())
+
+
+def mark_v2_broken(exc: Exception) -> None:
+    """Record a v2-kernel failure and disable ONLY the v2 route for the
+    rest of the process; the v1 kernel (and its measured 3.4× win)
+    keeps running."""
+    global _v2_broken
+    from makisu_tpu.utils import logging as log
+    _v2_broken = True
+    log.warning("pallas gear v2 kernel disabled for this process "
+                "(falling back to the v1 kernel): %s", str(exc)[:300])
 
 
 @functools.partial(jax.jit, static_argnames=("avg_bits", "interpret"))

@@ -118,10 +118,14 @@ def sha256_lanes_pallas(data: jax.Array, lengths: jax.Array,
 
 
 # This kernel's OWN breaker (a SHA failure must never disable the
-# device-validated gear kernel) and the one-time per-process device
-# parity verdict (None = not yet probed).
+# device-validated gear kernel) and the per-process device parity
+# verdicts, one per distinct (lanes, cap) bucket shape: each shape
+# compiles a DIFFERENT kernel program (different grid, tile, NB), so a
+# verdict for one shape says nothing about another — exactly the
+# shape-dependent-miscompile class the probe exists to catch (advisor
+# r3, medium).
 _broken = False
-_parity_ok: bool | None = None
+_parity_ok: dict[tuple[int, int], bool] = {}
 
 
 def mark_broken(exc: Exception) -> None:
@@ -132,48 +136,55 @@ def mark_broken(exc: Exception) -> None:
                 "(falling back to the XLA path): %s", str(exc)[:300])
 
 
-def _device_parity_ok() -> bool:
-    """Probe the kernel ONCE per process against hashlib ground truth
-    on the live backend before trusting it with production digests.
+def _device_parity_ok(lanes: int, cap: int) -> bool:
+    """Probe the kernel once per process PER BUCKET SHAPE against
+    hashlib ground truth on the live backend before trusting it with
+    production digests at that shape.
 
     Chunk digests are cache identity (cache/chunks.py): a kernel that
     compiled but produced wrong bytes on some future libtpu would
-    silently split identity between TPU and CPU builders. The probe
-    runs the PRODUCTION bucket shape (512 lanes x 16 KiB — the first
-    _BUCKETS entry, so the probe's compile is exactly the program the
-    first real flush reuses) over ragged lengths covering the padding
-    edges, compares with hashlib, and pins the process to the XLA path
-    on any mismatch or failure. The readback is bounded: a wedged
-    tunnel must degrade the probe, never hang the build
+    silently split identity between TPU and CPU builders. Every
+    distinct (lanes, cap) compiles a different kernel program
+    (different grid/tile/NB), so the verdict is cached per shape —
+    probing only the first bucket would leave the second bucket's
+    program (128 lanes, ~64KiB cap in chunker/cdc.py _BUCKETS)
+    unverified before its digests became cache identity. The probe runs
+    the exact production shape (its compile is the program the first
+    real flush at that shape reuses) over ragged lengths covering the
+    padding edges, compares with hashlib, and pins the process to the
+    XLA path on any mismatch or failure. The readback is bounded: a
+    wedged tunnel must degrade the probe, never hang the build
     (ops/backend.py sync discipline)."""
-    global _parity_ok
-    if _parity_ok is None:
+    key = (lanes, cap)
+    if key not in _parity_ok:
         import hashlib
 
         from makisu_tpu.ops import backend as _backend
 
-        rng = np.random.default_rng(0xEC0)
-        data = rng.integers(0, 256, size=(512, 16 * 1024),
-                            dtype=np.uint8)
-        lengths = rng.integers(0, 16 * 1024 - 9, size=512).astype(
-            np.int32)
-        lengths[:8] = (0, 1, 55, 56, 63, 64, 100, 16 * 1024 - 9)
+        rng = np.random.default_rng(0xEC0 ^ lanes ^ cap)
+        data = rng.integers(0, 256, size=(lanes, cap), dtype=np.uint8)
+        # SHA-256 padding needs 9 spare bytes to stay in-block; the
+        # production dispatch guarantees length <= cap - 64.
+        lengths = rng.integers(0, cap - 9, size=lanes).astype(np.int32)
+        edge = (0, 1, 55, 56, 63, 64, 100, cap - 9)
+        lengths[:len(edge)] = edge[:lanes]
         try:
             got = _backend.sync_bounded(
                 sha256_lanes_pallas(data, lengths),
-                "sha256 pallas parity probe")
-            _parity_ok = all(
+                f"sha256 pallas parity probe {lanes}x{cap}")
+            ok = all(
                 got[i].astype(">u4").tobytes()
                 == hashlib.sha256(data[i, :lengths[i]].tobytes()).digest()
-                for i in range(512))
-            if not _parity_ok:
+                for i in range(lanes))
+            _parity_ok[key] = ok
+            if not ok:
                 mark_broken(
-                    RuntimeError("parity probe: digest mismatch vs "
-                                 "hashlib"))
+                    RuntimeError(f"parity probe {lanes}x{cap}: digest "
+                                 "mismatch vs hashlib"))
         except Exception as e:  # noqa: BLE001 - kernel plane
             mark_broken(e)
-            _parity_ok = False
-    return _parity_ok
+            _parity_ok[key] = False
+    return _parity_ok[key]
 
 
 def sha256_lanes_auto(data, lengths):
@@ -190,7 +201,7 @@ def sha256_lanes_auto(data, lengths):
     if (not _broken
             and gear_pallas.env_enabled()
             and jax.default_backend() != "cpu"
-            and _device_parity_ok()):
+            and _device_parity_ok(*data.shape)):
         try:
             return sha256_lanes_pallas(data, lengths)
         except Exception as e:  # noqa: BLE001 - kernel plane

@@ -153,6 +153,47 @@ def test_chunk_session_v2_path_matches(monkeypatch):
     assert run() == baseline
 
 
+def test_v2_failure_falls_back_to_v1_not_xla(monkeypatch):
+    """A v2-kernel failure must trip ONLY v2's breaker (advisor r3):
+    the production-default v1 route — with its measured device win —
+    keeps running; chunks are identical either way."""
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    payload = np.random.default_rng(13).integers(
+        0, 256, size=400_000, dtype=np.uint8).tobytes()
+
+    def run():
+        s = ChunkSession(block=128 * 1024)
+        s.update(payload)
+        return [(c.offset, c.length, c.digest) for c in s.finish()]
+
+    baseline = run()
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic v2 Mosaic rejection")
+
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setenv("MAKISU_TPU_PALLAS_V2", "1")
+    monkeypatch.setattr(gear_pallas, "gear_bitmap_flat2", boom)
+    v1_calls = []
+    real_flat = gear_pallas.gear_bitmap_flat
+
+    def traced_v1(*a, **k):
+        v1_calls.append(1)
+        return real_flat(*a, **k)
+
+    monkeypatch.setattr(gear_pallas, "gear_bitmap_flat", traced_v1)
+    try:
+        assert run() == baseline
+        assert gear_pallas._v2_broken      # v2 disabled...
+        assert not gear_pallas._broken     # ...v1 breaker untouched
+        assert gear_pallas.pallas_enabled()
+        assert not gear_pallas.v2_enabled()
+        assert v1_calls                    # blocks rode the v1 kernel
+    finally:
+        gear_pallas._v2_broken = False
+
+
 def test_gear_bitmap_batch_matches_xla_above_window():
     """The SnapshotHasher kernel route must select the same candidate
     positions as the XLA route for every stream in the batch (positions

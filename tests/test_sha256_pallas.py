@@ -33,7 +33,7 @@ def _reset_breaker(monkeypatch):
     yield
     gear_pallas._broken = False
     sha256_pallas._broken = False
-    sha256_pallas._parity_ok = None
+    sha256_pallas._parity_ok = {}
 
 
 def test_auto_on_cpu_never_touches_kernel(monkeypatch):
@@ -71,7 +71,7 @@ def test_parity_probe_mismatch_pins_xla(monkeypatch):
         data, lengths)                       # correct XLA digests
     assert sha256_pallas._broken             # SHA breaker tripped...
     assert not gear_pallas._broken           # ...gear kernel unaffected
-    assert sha256_pallas._parity_ok is False
+    assert sha256_pallas._parity_ok[(8, 256)] is False
 
 
 def test_parity_probe_exception_pins_xla(monkeypatch):
@@ -103,7 +103,7 @@ def test_parity_probe_pass_routes_to_kernel(monkeypatch):
         data, lengths = np.asarray(data), np.asarray(lengths)
         calls.append(data.shape)
         # Digest-correct by construction (hashlib, not the slow-on-CPU
-        # lane path — the probe shape is the 512x16KiB bucket).
+        # lane path — the probe runs the production shape itself).
         out = np.zeros((len(lengths), 8), np.uint32)
         for i, n in enumerate(lengths):
             d = hashlib.sha256(data[i, :n].tobytes()).digest()
@@ -118,8 +118,55 @@ def test_parity_probe_pass_routes_to_kernel(monkeypatch):
     got = np.asarray(sha256_pallas.sha256_lanes_auto(data, lengths))
     assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
         data, lengths)
-    assert sha256_pallas._parity_ok is True
+    assert sha256_pallas._parity_ok[(8, 256)] is True
     assert len(calls) == 2                   # probe + production call
+
+
+def test_parity_probe_runs_per_bucket_shape(monkeypatch):
+    """Each distinct (lanes, cap) compiles a different kernel program,
+    so each must be parity-probed before its digests become cache
+    identity (advisor r3, medium): a kernel correct at the first bucket
+    shape but wrong at the second must be caught when the second shape
+    first flushes — never trusted on the strength of the first probe."""
+    monkeypatch.setenv("MAKISU_TPU_PALLAS", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    probed_shapes = []
+
+    def shape_dependent_kernel(data, lengths, interpret=False):
+        import hashlib
+
+        data, lengths = np.asarray(data), np.asarray(lengths)
+        probed_shapes.append(data.shape)
+        if data.shape[1] >= 512:  # "miscompiles" at the bigger bucket
+            return np.zeros((len(lengths), 8), np.uint32)
+        out = np.zeros((len(lengths), 8), np.uint32)
+        for i, n in enumerate(lengths):
+            d = hashlib.sha256(data[i, :n].tobytes()).digest()
+            out[i] = np.frombuffer(d, dtype=">u4")
+        return out
+
+    monkeypatch.setattr(sha256_pallas, "sha256_lanes_pallas",
+                        shape_dependent_kernel)
+    rng = np.random.default_rng(5)
+
+    small = rng.integers(0, 256, size=(8, 256), dtype=np.uint8)
+    small_len = rng.integers(0, 247, size=8).astype(np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(small, small_len))
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        small, small_len)
+    assert sha256_pallas._parity_ok[(8, 256)] is True
+    assert not sha256_pallas._broken
+
+    big = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    big_len = rng.integers(0, 503, size=4).astype(np.int32)
+    got = np.asarray(sha256_pallas.sha256_lanes_auto(big, big_len))
+    # The second shape's probe caught the miscompile; production digests
+    # came from the XLA path and are correct.
+    assert [g.astype(">u4").tobytes() for g in got] == _hashlib_digests(
+        big, big_len)
+    assert sha256_pallas._parity_ok[(4, 512)] is False
+    assert (8, 256) in [s for s in probed_shapes]
+    assert (4, 512) in [s for s in probed_shapes]
 
 
 @pytest.mark.skipif(
