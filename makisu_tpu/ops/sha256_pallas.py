@@ -163,10 +163,13 @@ def _device_parity_ok(lanes: int, cap: int) -> bool:
 
         rng = np.random.default_rng(0xEC0 ^ lanes ^ cap)
         data = rng.integers(0, 256, size=(lanes, cap), dtype=np.uint8)
-        # SHA-256 padding needs 9 spare bytes to stay in-block; the
-        # production dispatch guarantees length <= cap - 64.
+        # SHA-256 padding needs 9 spare bytes to stay in-block; edge
+        # lengths clamp to cap - 9 so a small-cap shape can never
+        # produce a spurious mismatch (hashlib would hash the clamped
+        # slice while the kernel was told the unclamped length).
         lengths = rng.integers(0, cap - 9, size=lanes).astype(np.int32)
-        edge = (0, 1, 55, 56, 63, 64, 100, cap - 9)
+        edge = tuple(min(e, cap - 9)
+                     for e in (0, 1, 55, 56, 63, 64, 100, cap - 9))
         lengths[:len(edge)] = edge[:lanes]
         try:
             got = _backend.sync_bounded(
@@ -198,7 +201,13 @@ def sha256_lanes_auto(data, lengths):
     bit-identical either way (asserted in tests)."""
     from makisu_tpu.ops import gear_pallas
 
+    # Shape gate BEFORE the probe: a cap the kernel structurally can't
+    # take (not a 64-multiple, or too small for padding edges) routes
+    # straight to XLA without burning the process-wide breaker on a
+    # guaranteed probe failure.
+    cap = data.shape[-1]
     if (not _broken
+            and cap % 64 == 0 and cap >= 64
             and gear_pallas.env_enabled()
             and jax.default_backend() != "cpu"
             and _device_parity_ok(*data.shape)):

@@ -40,6 +40,15 @@ from makisu_tpu.utils import mountinfo  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _isolated_probe_cache(tmp_path, monkeypatch):
+    """The cross-process wedge cache (ops/backend.py) must never leak
+    between tests — or from a real wedged-tunnel session into the
+    suite."""
+    monkeypatch.setenv("MAKISU_TPU_PROBE_CACHE",
+                       str(tmp_path / "probe-wedge.json"))
+
+
+@pytest.fixture(autouse=True)
 def _no_mounts():
     """Tmp build roots must not inherit the host mount table's skip
     rules (one definition for every suite; tests needing specific
