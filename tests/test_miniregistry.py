@@ -1,0 +1,221 @@
+"""Spec-level tests for the vendored distribution registry.
+
+These drive tools/miniregistry.py with RAW http.client requests — not
+the repo's RegistryClient — so the server's spec conformance is pinned
+independently of the client it exists to test (a shared blind spot
+between client and server would defeat the e2e tier's purpose).
+"""
+
+import hashlib
+import http.client
+import json
+
+import pytest
+
+from makisu_tpu.tools.miniregistry import MiniRegistry
+
+
+@pytest.fixture()
+def reg():
+    with MiniRegistry() as r:
+        yield r
+
+
+def _conn(reg):
+    host, _, port = reg.addr.partition(":")
+    return http.client.HTTPConnection(host, int(port), timeout=10)
+
+
+def _req(reg, method, path, body=None, headers=None):
+    c = _conn(reg)
+    c.request(method, path, body=body, headers=headers or {})
+    resp = c.getresponse()
+    data = resp.read()
+    c.close()
+    return resp, data
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def test_api_version_check(reg):
+    resp, _ = _req(reg, "GET", "/v2/")
+    assert resp.status == 200
+    assert resp.headers["Docker-Distribution-Api-Version"] == \
+        "registry/2.0"
+
+
+def test_monolithic_post_upload_and_pull(reg):
+    blob = b"monolithic payload"
+    d = _digest(blob)
+    resp, _ = _req(reg, "POST", f"/v2/lib/app/blobs/uploads/?digest={d}",
+                   body=blob)
+    assert resp.status == 201
+    assert resp.headers["Docker-Content-Digest"] == d
+    resp, data = _req(reg, "GET", f"/v2/lib/app/blobs/{d}")
+    assert resp.status == 200 and data == blob
+    # HEAD: headers only
+    resp, data = _req(reg, "HEAD", f"/v2/lib/app/blobs/{d}")
+    assert resp.status == 200 and data == b""
+    assert resp.headers["Docker-Content-Digest"] == d
+
+
+def test_chunked_upload_range_discipline(reg):
+    blob = b"0123456789" * 100
+    resp, _ = _req(reg, "POST", "/v2/lib/app/blobs/uploads/")
+    assert resp.status == 202
+    loc = resp.headers["Location"]
+    assert resp.headers["Docker-Upload-UUID"]
+    # In-order chunks with Content-Range accepted, ranges echoed.
+    resp, _ = _req(reg, "PATCH", loc, body=blob[:400],
+                   headers={"Content-Range": "0-399"})
+    assert resp.status == 202
+    assert resp.headers["Range"] == "0-399"
+    # Out-of-order chunk: 416 with the current range.
+    resp, _ = _req(reg, "PATCH", loc, body=blob[500:],
+                   headers={"Content-Range": "500-999"})
+    assert resp.status == 416
+    assert resp.headers["Range"] == "0-399"
+    resp, _ = _req(reg, "PATCH", loc, body=blob[400:],
+                   headers={"Content-Range": "400-999"})
+    assert resp.status == 202
+    d = _digest(blob)
+    resp, _ = _req(reg, "PUT", f"{loc}?digest={d}")
+    assert resp.status == 201
+    resp, data = _req(reg, "GET", f"/v2/lib/app/blobs/{d}")
+    assert resp.status == 200 and data == blob
+
+
+def test_upload_digest_mismatch_rejected(reg):
+    resp, _ = _req(reg, "POST", "/v2/lib/app/blobs/uploads/")
+    loc = resp.headers["Location"]
+    _req(reg, "PATCH", loc, body=b"actual content")
+    wrong = _digest(b"different content")
+    resp, data = _req(reg, "PUT", f"{loc}?digest={wrong}")
+    assert resp.status == 400
+    assert json.loads(data)["errors"][0]["code"] == "DIGEST_INVALID"
+    # The upload session is still consumable after the failed commit.
+    right = _digest(b"actual content")
+    resp, _ = _req(reg, "PUT", f"{loc}?digest={right}")
+    assert resp.status == 201
+
+
+def test_blob_unknown_error_shape(reg):
+    resp, data = _req(reg, "GET", f"/v2/lib/app/blobs/{_digest(b'no')}")
+    assert resp.status == 404
+    err = json.loads(data)["errors"][0]
+    assert err["code"] == "BLOB_UNKNOWN"
+
+
+def _push_blob(reg, name, blob):
+    d = _digest(blob)
+    resp, _ = _req(reg, "POST", f"/v2/{name}/blobs/uploads/?digest={d}",
+                   body=blob)
+    assert resp.status == 201
+    return d
+
+
+def _schema2(config_digest, config_size, layers):
+    return {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.docker.distribution.manifest"
+                     ".v2+json",
+        "config": {
+            "mediaType": "application/vnd.docker.container.image.v1+json",
+            "digest": config_digest, "size": config_size,
+        },
+        "layers": [
+            {"mediaType": "application/vnd.docker.image.rootfs.diff"
+                          ".tar.gzip", "digest": d, "size": s}
+            for d, s in layers
+        ],
+    }
+
+
+def test_manifest_push_requires_referenced_blobs(reg):
+    cfg = b'{"os": "linux"}'
+    cfg_d = _push_blob(reg, "lib/app", cfg)
+    man = _schema2(cfg_d, len(cfg), [(_digest(b"missing layer"), 13)])
+    resp, data = _req(
+        reg, "PUT", "/v2/lib/app/manifests/v1",
+        body=json.dumps(man).encode(),
+        headers={"Content-Type": man["mediaType"]})
+    assert resp.status == 400
+    assert json.loads(data)["errors"][0]["code"] == \
+        "MANIFEST_BLOB_UNKNOWN"
+
+
+def test_manifest_roundtrip_by_tag_and_digest(reg):
+    cfg, layer = b'{"os": "linux"}', b"layer bytes"
+    cfg_d = _push_blob(reg, "lib/app", cfg)
+    layer_d = _push_blob(reg, "lib/app", layer)
+    man = _schema2(cfg_d, len(cfg), [(layer_d, len(layer))])
+    raw = json.dumps(man).encode()
+    resp, _ = _req(reg, "PUT", "/v2/lib/app/manifests/v1", body=raw,
+                   headers={"Content-Type": man["mediaType"]})
+    assert resp.status == 201
+    man_d = resp.headers["Docker-Content-Digest"]
+    assert man_d == _digest(raw)
+    for ref in ("v1", man_d):
+        resp, data = _req(reg, "GET", f"/v2/lib/app/manifests/{ref}")
+        assert resp.status == 200 and data == raw
+        assert resp.headers["Content-Type"] == man["mediaType"]
+        assert resp.headers["Docker-Content-Digest"] == man_d
+    resp, data = _req(reg, "GET", "/v2/lib/app/tags/list")
+    assert json.loads(data) == {"name": "lib/app", "tags": ["v1"]}
+
+
+def test_manifest_put_by_digest_must_match(reg):
+    cfg = b"{}"
+    cfg_d = _push_blob(reg, "lib/app", cfg)
+    man = _schema2(cfg_d, len(cfg), [])
+    raw = json.dumps(man).encode()
+    wrong = _digest(b"other")
+    resp, data = _req(reg, "PUT", f"/v2/lib/app/manifests/{wrong}",
+                      body=raw,
+                      headers={"Content-Type": man["mediaType"]})
+    assert resp.status == 400
+    assert json.loads(data)["errors"][0]["code"] == "DIGEST_INVALID"
+
+
+def test_manifest_list_requires_sub_manifests(reg):
+    idx = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.docker.distribution.manifest"
+                     ".list.v2+json",
+        "manifests": [{
+            "mediaType": "application/vnd.docker.distribution.manifest"
+                         ".v2+json",
+            "digest": _digest(b"nope"), "size": 4,
+            "platform": {"os": "linux", "architecture": "amd64"},
+        }],
+    }
+    resp, data = _req(reg, "PUT", "/v2/lib/app/manifests/multi",
+                      body=json.dumps(idx).encode(),
+                      headers={"Content-Type": idx["mediaType"]})
+    assert resp.status == 400
+    assert json.loads(data)["errors"][0]["code"] == \
+        "MANIFEST_BLOB_UNKNOWN"
+
+
+def test_cross_repo_mount(reg):
+    blob = b"shared base layer"
+    d = _push_blob(reg, "lib/base", blob)
+    resp, _ = _req(reg, "POST",
+                   f"/v2/lib/app/blobs/uploads/?mount={d}&from=lib/base")
+    assert resp.status == 201
+    resp, data = _req(reg, "GET", f"/v2/lib/app/blobs/{d}")
+    assert resp.status == 200 and data == blob
+    # Mount of a missing blob falls back to a fresh upload session.
+    resp, _ = _req(
+        reg, "POST",
+        f"/v2/lib/app/blobs/uploads/?mount={_digest(b'no')}&from=lib/base")
+    assert resp.status == 202
+    assert resp.headers["Docker-Upload-UUID"]
+
+
+def test_blobs_are_repo_scoped(reg):
+    d = _push_blob(reg, "lib/one", b"scoped")
+    resp, _ = _req(reg, "GET", f"/v2/lib/other/blobs/{d}")
+    assert resp.status == 404
