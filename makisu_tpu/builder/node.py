@@ -51,7 +51,7 @@ class BuildNode:
         cached = self.digest_pairs is not None
         if cached:
             for pair in self.digest_pairs:
-                self._apply_layer(pair, opts.modify_fs)
+                self._apply_layer(pair, opts.modify_fs, cache_mgr)
         if opts.skip_build:
             log.info("skipping execution; a later step was cached")
         elif cached:
@@ -75,10 +75,21 @@ class BuildNode:
         log.info("pushing cache id %s", self.cache_id)
         cache_mgr.push_cache(self.cache_id, pair, commit)
 
-    def _apply_layer(self, pair: DigestPair, modify_fs: bool) -> None:
+    def _apply_layer(self, pair: DigestPair, modify_fs: bool,
+                     cache_mgr=None) -> None:
         hex_digest = pair.gzip_descriptor.digest.hex()
         log.info("applying cached layer %s (unpack=%s)", hex_digest,
                  modify_fs)
+        # Application consumes the UNCOMPRESSED tar stream; route it
+        # through the cache manager when it can supply one — with chunk
+        # dedup attached, a lazily-pulled layer streams straight from
+        # local chunks (no blob transfer, no gzip inflate at all).
+        open_tar = getattr(cache_mgr, "open_layer_tar", None)
+        if open_tar is not None:
+            with open_tar(pair) as gz:
+                with tarfile.open(fileobj=gz, mode="r|") as tf:
+                    self.ctx.memfs.update_from_tar(tf, untar=modify_fs)
+            return
         with self.ctx.image_store.layers.open(hex_digest) as f:
             with tario.gzip_reader(f) as gz:
                 with tarfile.open(fileobj=gz, mode="r|") as tf:

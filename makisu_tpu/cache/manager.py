@@ -82,16 +82,27 @@ class CacheManager:
         self.store = image_store
         self.registry = registry_client
         self._mem: dict[str, str] = {}
+        # Lazily-materializable cache hits: gzip hex digest -> raw entry.
+        # A hit whose blob is not local no longer transfers it eagerly;
+        # the bytes are produced only when something actually needs them
+        # (layer apply, export, or an upload the target registry can't
+        # HEAD-skip) — the reference eagerly downloads every cached
+        # layer (lib/cache/cache_manager.go DownloadCacheLayer), which
+        # for the 1%-edit warm-rebuild scenario is almost always wasted
+        # wire time. MAKISU_TPU_LAZY_CACHE=0 restores eager pulls.
+        self._lazy: dict[str, str] = {}
         self._lock = threading.Lock()
         self._pushes: list[threading.Thread] = []
 
+    @staticmethod
+    def lazy_enabled() -> bool:
+        import os
+        return os.environ.get("MAKISU_TPU_LAZY_CACHE", "1") == "1"
+
     # -- pull -------------------------------------------------------------
 
-    def pull_cache(self, cache_id: str) -> DigestPair | None:
-        """Layer for this cache ID. Returns None for the EMPTY sentinel (a
-        step known to commit nothing); raises CacheMiss when no usable
-        entry exists. The blob lands in the local store (from the registry
-        if necessary)."""
+    def _get_raw(self, cache_id: str) -> str | None:
+        """Entry lookup: build-local memory first, then the KV chain."""
         raw = self._mem.get(cache_id)
         if raw is None:
             for attempt in range(_KV_RETRIES):
@@ -102,7 +113,16 @@ class CacheManager:
                     log.warning("cache KV get %s failed (try %d): %s",
                                 cache_id, attempt + 1, e)
             else:
-                raise CacheMiss(cache_id)
+                return None
+        return raw
+
+    def pull_cache(self, cache_id: str) -> DigestPair | None:
+        """Layer for this cache ID. Returns None for the EMPTY sentinel (a
+        step known to commit nothing); raises CacheMiss when no usable
+        entry exists. The blob is NOT transferred eagerly when a
+        materialization route exists (see _lazy); callers that need the
+        bytes go through open_layer_tar()/materialize()."""
+        raw = self._get_raw(cache_id)
         if raw is None:
             raise CacheMiss(cache_id)
         pair, _chunks = decode_entry(raw)
@@ -115,9 +135,76 @@ class CacheManager:
                 log.info("cache hit %s but layer %s not local; ignoring",
                          cache_id, hex_digest)
                 raise CacheMiss(cache_id)
+            if self.lazy_enabled():
+                # Materializability must be settled HERE: a hit is a
+                # promise the build keeps (execution is skipped), so a
+                # KV entry pointing at an evaporated blob must degrade
+                # to a miss (rebuild) now, not fail the build at apply
+                # time. One HEAD per hit — vs the full transfer the
+                # eager design (and the reference) paid.
+                try:
+                    remote_ok = self.registry.layer_exists(
+                        pair.gzip_descriptor.digest)
+                except Exception as e:  # noqa: BLE001 - network plane
+                    log.warning("cache hit %s: blob HEAD failed (%s); "
+                                "treating as miss", cache_id, e)
+                    remote_ok = False
+                if not remote_ok:
+                    log.info("cache hit %s but blob %s gone from the "
+                             "registry; ignoring", cache_id, hex_digest)
+                    raise CacheMiss(cache_id)
+                with self._lock:
+                    self._lazy[hex_digest] = raw
+                log.info("cache hit %s -> %s (lazy: blob deferred)",
+                         cache_id, hex_digest)
+                return pair
             self.registry.pull_layer(pair.gzip_descriptor.digest)
         log.info("cache hit %s -> %s", cache_id, hex_digest)
         return pair
+
+    # -- materialization (the lazy half of pull) --------------------------
+
+    def materialize(self, hex_digest: str) -> str:
+        """Ensure the blob exists in the local store; returns its path.
+        Base route: registry transfer. (attach_chunk_dedup overrides
+        this with chunk reconstitution first.)"""
+        if self.store.layers.exists(hex_digest):
+            return self.store.layers.path(hex_digest)
+        if self.registry is None:
+            raise CacheMiss(f"layer {hex_digest} not local and no "
+                            "registry to materialize it from")
+        path = self.registry.pull_layer(Digest.from_hex(hex_digest))
+        with self._lock:
+            self._lazy.pop(hex_digest, None)
+        return path
+
+    def materialize_pending(self) -> None:
+        """Materialize every deferred blob (export paths: docker-save,
+        --dest, --oci-dest, --load need real bytes for every layer)."""
+        with self._lock:
+            pending = list(self._lazy)
+        for hex_digest in pending:
+            self.materialize(hex_digest)
+
+    def open_layer_tar(self, pair: DigestPair):
+        """Context manager yielding the layer's UNCOMPRESSED tar stream
+        (what layer application actually consumes). Base route:
+        materialize the gzip blob, then inflate. attach_chunk_dedup
+        overrides this to stream straight from chunks — no gzip bytes
+        produced or inflated at all."""
+        import contextlib
+
+        from makisu_tpu import tario
+
+        @contextlib.contextmanager
+        def _open():
+            self.materialize(pair.gzip_descriptor.digest.hex())
+            with self.store.layers.open(
+                    pair.gzip_descriptor.digest.hex()) as f:
+                with tario.gzip_reader(f) as gz:
+                    yield gz
+
+        return _open()
 
     # -- push -------------------------------------------------------------
 
@@ -172,3 +259,6 @@ class NoopCacheManager:
 
     def wait_for_push(self) -> None:
         pass
+
+    def materialize_pending(self) -> None:
+        pass  # no cache: every layer was committed locally

@@ -148,7 +148,7 @@ def _parse_build_args(pairs: list[str]) -> dict[str, str]:
     return out
 
 
-def _new_cache_manager(args, store):
+def _new_cache_manager(args, store, registry_client=None):
     from makisu_tpu.cache import CacheManager, FSStore, HTTPStore, RedisStore
     from makisu_tpu.dockerfile import parse_duration
     ttl = parse_duration(args.local_cache_ttl) / 1e9
@@ -163,7 +163,7 @@ def _new_cache_manager(args, store):
     else:
         kv = FSStore(os.path.join(store.root,
                                   pathutils.CACHE_KV_FILE_NAME), ttl)
-    return CacheManager(kv, store)
+    return CacheManager(kv, store, registry_client=registry_client)
 
 
 def cmd_build(args) -> int:
@@ -202,7 +202,19 @@ def cmd_build(args) -> int:
                            hasher=get_hasher(args.hasher),
                            blacklist=blacklist,
                            gzip_backend_id=gzip_backend_id)
-        cache_mgr = _new_cache_manager(args, store) or NoopCacheManager()
+        # The first push registry doubles as the cache's blob/chunk
+        # transfer plane (the reference's registryCacheManager pulls
+        # cached layers through the push registry the same way,
+        # lib/cache/cache_manager.go:116-182): a KV hit from another
+        # builder is materializable from there — lazily, and at chunk
+        # granularity when the TPU hasher indexed the layer.
+        cache_registry = None
+        if args.push:
+            cache_registry = new_client(
+                store, target.with_registry(args.push[0]),
+                config_map=registry_config_map)
+        cache_mgr = (_new_cache_manager(args, store, cache_registry)
+                     or NoopCacheManager())
         if args.hasher == "tpu" and not isinstance(cache_mgr,
                                                    NoopCacheManager):
             from makisu_tpu.cache.chunks import attach_chunk_dedup
@@ -225,16 +237,25 @@ def cmd_build(args) -> int:
                 preserver.restore()
         log.info("successfully built image %s", target)
 
+        # Lazily-pulled cache hits hold no local blob; pushes
+        # materialize per-blob only when the target registry can't
+        # HEAD-skip (the materialize_blob hook), export paths need every
+        # byte (materialize_pending below).
+        materializer = getattr(cache_mgr, "materialize", None)
         for registry in args.push:
             name = target.with_registry(registry)
             client = new_client(store, name,
                                 config_map=registry_config_map)
+            client.materialize_blob = materializer
             client.push(name if name.registry else target)
             for replica in replicas:
-                new_client(store, replica.with_registry(registry),
-                           config_map=registry_config_map).push(
-                    replica.with_registry(registry))
+                rclient = new_client(store, replica.with_registry(registry),
+                                     config_map=registry_config_map)
+                rclient.materialize_blob = materializer
+                rclient.push(replica.with_registry(registry))
             log.info("successfully pushed %s to %s", name, registry)
+        if args.dest or args.oci_dest or args.load:
+            cache_mgr.materialize_pending()
         if args.dest:
             from makisu_tpu.docker.save import write_save_tar
             write_save_tar(store, target, args.dest)

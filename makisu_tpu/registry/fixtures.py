@@ -75,7 +75,10 @@ class RegistryFixture(Transport):
         self.uploads: dict[str, bytearray] = {}    # uuid → partial blob
         self.overrides: list[tuple[str, str, Response]] = []
         self.requests: list[tuple[str, str]] = []  # log for assertions
-        self._next_upload = 0
+        # Chunk pushes arrive from a thread pool; upload-session ids
+        # must not collide under concurrency.
+        import itertools
+        self._upload_ids = itertools.count()
         # When set, /v2/ endpoints demand "Bearer <require_token>" and
         # 401-challenge to /token (exercises the auth dance).
         self.require_token = require_token
@@ -191,8 +194,7 @@ class RegistryFixture(Transport):
 
         m = re.fullmatch(r"/v2/(.+)/blobs/uploads/", path)
         if m and method == "POST":
-            uuid = f"upload-{self._next_upload}"
-            self._next_upload += 1
+            uuid = f"upload-{next(self._upload_ids)}"
             self.uploads[uuid] = bytearray()
             return Response(
                 202, {"location": f"/v2/{m.group(1)}/blobs/uploads/{uuid}"},

@@ -81,9 +81,22 @@ class _State:
         self.repos: dict[str, _Repo] = {}
         self.uploads: dict[str, tuple[str, bytearray]] = {}
         self.lock = threading.Lock()
+        # Simulated wire bandwidth for blob bodies (0 = unthrottled):
+        # lets benchmarks model a real link (the reference's own default
+        # push rate limit is 100 MB/s, lib/registry/config.go:86-88)
+        # instead of loopback's fantasy bandwidth.
+        self.throttle_mbps = 0.0
+        # Byte accounting for benchmarks: blob bytes served / accepted.
+        self.blob_bytes_out = 0
+        self.blob_bytes_in = 0
 
     def repo(self, name: str) -> _Repo:
         return self.repos.setdefault(name, _Repo())
+
+    def wire_delay(self, nbytes: int) -> None:
+        if self.throttle_mbps > 0 and nbytes > 0:
+            import time
+            time.sleep(nbytes / (self.throttle_mbps * 1e6))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -118,7 +131,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(n) if n else b""
+        data = self.rfile.read(n) if n else b""
+        if data and "/blobs/" in self.path:
+            self.st.wire_delay(len(data))
+            with self.st.lock:
+                self.st.blob_bytes_in += len(data)
+        return data
 
     def _reply(self, status: int, body: bytes = b"",
                headers: dict[str, str] | None = None) -> None:
@@ -184,6 +202,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "BLOB_UNKNOWN", "blob unknown to registry",
                         digest)
             return
+        if self.command == "GET":
+            self.st.wire_delay(len(data))
+            with self.st.lock:
+                self.st.blob_bytes_out += len(data)
         self._reply(200, data, {
             "Content-Type": "application/octet-stream",
             "Docker-Content-Digest": digest,
@@ -389,9 +411,16 @@ class MiniRegistry:
     """An in-process distribution-spec registry over real TCP."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 verbose: bool = False) -> None:
+                 verbose: bool = False,
+                 throttle_mbps: float = 0.0) -> None:
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        # Nagle + delayed-ACK interaction costs ~40ms PER REQUEST on
+        # loopback (urllib's header/body write-write-read pattern);
+        # chunk dedup issues thousands of small requests, so this
+        # single flag is a ~50x throughput difference.
+        self._server.disable_nagle_algorithm = True
         self._server.state = _State()
+        self._server.state.throttle_mbps = throttle_mbps
         self._server.verbose = verbose
         self._server.daemon_threads = True
         self._thread: threading.Thread | None = None
@@ -400,6 +429,10 @@ class MiniRegistry:
     def addr(self) -> str:
         host, port = self._server.server_address[:2]
         return f"{host}:{port}"
+
+    @property
+    def state(self) -> _State:
+        return self._server.state
 
     def start(self) -> "MiniRegistry":
         self._thread = threading.Thread(

@@ -63,8 +63,137 @@ def test_layer_reconstitution_across_builders(tmp_path):
     manifest_b = plan.execute()
     assert [str(l.digest) for l in manifest_a.layers] == \
         [str(l.digest) for l in manifest_b.layers]
-    # The blob exists in B's store now, rebuilt from chunks.
-    assert store_b.layers.exists(manifest_b.layers[0].digest.hex())
+    # Lazy contract: the build itself never produced the gzip blob (the
+    # layer applied straight from chunks — no transfer, no gzip work)...
+    hex_digest = manifest_b.layers[0].digest.hex()
+    assert not store_b.layers.exists(hex_digest)
+    # ...and materialization on demand (export/push-upload paths)
+    # rebuilds it from chunks, byte-identical to A's blob.
+    mgr.materialize_pending()
+    assert store_b.layers.exists(hex_digest)
+    with store_b.layers.open(hex_digest) as fb:
+        with store_a.layers.open(hex_digest) as fa:
+            assert fb.read() == fa.read()
+
+
+def test_warm_rebuild_after_edit_moves_no_blob_bytes(tmp_path):
+    """The north-star scenario end to end: builder A builds v2 (1% edit
+    of v1) and pushes; builder B — who built v1, so holds v1's chunks —
+    rebuilds v2. B's build must (a) hit the cache, (b) transfer only
+    the NOVEL chunks (never the blob), (c) apply the layer without
+    creating the gzip blob at all, and (d) push with zero blob uploads
+    (the registry already has A's blob). The reference's whole-layer
+    cache transfers the full blob for the same rebuild."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    rng = np.random.default_rng(3)
+    v1 = rng.integers(0, 256, size=600_000, dtype=np.uint8).tobytes()
+    v2 = v1[:5_000] + b"EDITEDEDIT" + v1[5_000:]  # ~1% novelty w/ shift
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+
+    def one_build(tag, store_name, chunk_name, payload, push=False):
+        ctx_dir = tmp_path / f"ctx-{tag}"
+        ctx_dir.mkdir(exist_ok=True)
+        (ctx_dir / "blob.bin").write_bytes(payload)
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/ns",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        attach_chunk_dedup(mgr, str(tmp_path / chunk_name))
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/ns", tag), [], mgr,
+                        stages, allow_modify_fs=False, force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        if push:
+            push_client = RegistryClient(store, "registry.test",
+                                         "cache/ns", transport=fixture)
+            push_client.materialize_blob = mgr.materialize
+            for layer in manifest.layers:
+                push_client.push_layer(layer.digest)
+        return manifest, store, mgr
+
+    # B builds v1 first (its chunk store now holds v1's chunks).
+    one_build("b-v1", "store-b", "chunks-b", v1)
+    # A builds v2 and pushes blob + chunks + KV entries.
+    m_a, _, _ = one_build("a-v2", "store-a", "chunks-a", v2, push=True)
+    layer_hex = m_a.layers[0].digest.hex()
+    assert layer_hex in fixture.blobs
+
+    # B rebuilds v2. Count the blob traffic its build generates.
+    before = len(fixture.requests)
+    m_b, store_b, _ = one_build("b-v2", "store-b", "chunks-b", v2,
+                                push=True)
+    new_requests = fixture.requests[before:]
+    assert [str(l.digest) for l in m_b.layers] == \
+        [str(l.digest) for l in m_a.layers]
+    # (b) the layer blob was never downloaded...
+    blob_gets = [u for m, u in new_requests
+                 if m == "GET" and layer_hex in u]
+    assert blob_gets == []
+    # ...novel chunks were (a strict subset of the layer's chunks).
+    chunk_gets = [u for m, u in new_requests
+                  if m == "GET" and "/blobs/sha256:" in u]
+    assert 0 < len(chunk_gets) < 20
+    # (c) B never produced the gzip blob locally...
+    assert not store_b.layers.exists(layer_hex)
+    # (d) ...and pushed nothing: the registry had every blob already.
+    uploads = [u for m, u in new_requests
+               if m in ("POST", "PATCH", "PUT") and "/blobs/" in u]
+    assert uploads == []
+
+
+def test_lazy_cache_disabled_restores_eager_pull(tmp_path, monkeypatch):
+    """MAKISU_TPU_LAZY_CACHE=0: a cache hit transfers the blob at pull
+    time, exactly the old (and reference) behavior."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    monkeypatch.setenv("MAKISU_TPU_LAZY_CACHE", "0")
+    payload = np.random.default_rng(4).integers(
+        0, 256, size=200_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "blob.bin").write_bytes(payload)
+
+    def one_builder(tag, store_name):
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/eager",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/eager", tag), [], mgr,
+                         stages, allow_modify_fs=False,
+                         force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        for layer in manifest.layers:
+            RegistryClient(store, "registry.test", "cache/eager",
+                           transport=fixture).push_layer(layer.digest)
+        return manifest, store
+
+    m1, _ = one_builder("a", "store-a")
+    m2, store_b = one_builder("b", "store-b")
+    assert [str(l.digest) for l in m1.layers] == \
+        [str(l.digest) for l in m2.layers]
+    # Eager: the blob IS local right after the build.
+    assert store_b.layers.exists(m2.layers[0].digest.hex())
 
 
 def test_chunk_coverage_after_small_edit(tmp_path):
@@ -148,18 +277,23 @@ def test_chunks_distribute_through_registry_plane(tmp_path):
                          stages, allow_modify_fs=False, force_commit=True)
         manifest = plan.execute()
         mgr.wait_for_push()
-        return manifest, store
+        return manifest, store, mgr
 
-    m1, _ = one_builder("a", "store-a", "chunks-a")
+    m1, _, _ = one_builder("a", "store-a", "chunks-a")
     assert fixture.blobs  # chunks + layers pushed to the registry
     # Builder B: empty layer store AND empty chunk store. Simulate the
     # layer blob being evicted from the registry (only chunks remain) so
     # reconstitution is the only path.
     layer_hex = m1.layers[0].digest.hex()
     evicted = fixture.blobs.pop(layer_hex)
-    m2, store_b = one_builder("b", "store-b", "chunks-b")
+    m2, store_b, mgr_b = one_builder("b", "store-b", "chunks-b")
     assert [str(l.digest) for l in m1.layers] == \
         [str(l.digest) for l in m2.layers]
+    # Lazy contract: the build applied the layer from registry-fetched
+    # chunks without producing the blob; materialization rebuilds it
+    # byte-identical even though the registry no longer has it.
+    assert not store_b.layers.exists(layer_hex)
+    mgr_b.materialize_pending()
     assert store_b.layers.exists(layer_hex)
     with store_b.layers.open(layer_hex) as f:
         assert f.read() == evicted  # byte-identical reconstitution
@@ -198,9 +332,9 @@ def test_chunks_survive_registry_gc(tmp_path):
                          stages, allow_modify_fs=False, force_commit=True)
         manifest = plan.execute()
         mgr.wait_for_push()
-        return manifest, store
+        return manifest, store, mgr
 
-    m1, _ = one_builder("a", "store-a", "chunks-a")
+    m1, _, _ = one_builder("a", "store-a", "chunks-a")
     # A pin manifest exists for the layer.
     layer_hex = m1.layers[0].digest.hex()
     pin_tag = f"cache/gc:makisu-chunks-{layer_hex[:40]}"
@@ -212,10 +346,11 @@ def test_chunks_survive_registry_gc(tmp_path):
     assert layer_hex not in fixture.blobs
     assert fixture.blobs  # pinned chunks survived
     # A fresh builder reconstitutes the layer purely from GC-surviving
-    # chunks.
-    m2, store_b = one_builder("b", "store-b", "chunks-b")
+    # chunks (lazily — materialization produces the actual blob).
+    m2, store_b, mgr_b = one_builder("b", "store-b", "chunks-b")
     assert [str(l.digest) for l in m1.layers] == \
         [str(l.digest) for l in m2.layers]
+    mgr_b.materialize_pending()
     assert store_b.layers.exists(layer_hex)
 
 
