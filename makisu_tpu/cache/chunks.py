@@ -219,7 +219,10 @@ class ChunkStore:
         after an incremental edit — this is the wire transfer chunk
         dedup reduces to) fetch on a thread pool, since per-blob round
         trips, not bytes, dominate small-chunk transfer."""
-        missing = [h for _, _, h in chunks if not self.cas.exists(h)]
+        # A digest repeated at several offsets (dedup within one layer)
+        # must fetch once, not once per occurrence racing on the pool.
+        missing = sorted({h for _, _, h in chunks
+                          if not self.cas.exists(h)})
         if not missing:
             return True
         if self.registry is None:
@@ -255,6 +258,18 @@ class ChunkStore:
         largest chunk — a 10GB layer (BASELINE config 4) never
         materializes in RAM."""
         import tempfile
+        if gz_backend is not None and not tario.backend_id_usable(
+                gz_backend):
+            # Byte-identity is unachievable without the producing
+            # compressor; report "cannot reconstitute" so the caller
+            # falls back to the blob transfer route instead of dying
+            # inside gzip_writer (pull_cache normally filters these
+            # hits up front — this guards entries registered by the
+            # base blob route).
+            log.warning("cannot reconstitute %s: gzip backend %r not "
+                        "usable here", pair.gzip_descriptor.digest,
+                        gz_backend)
+            return None
         tar_digest = hashlib.sha256()
         pos = 0
         # Temp file lives beside the chunk CAS (not $TMPDIR, commonly
@@ -482,40 +497,58 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         1% edit, its transfer cost is the novel fraction of the layer,
         not the whole blob — then the base manager's blob route. Like
         the base route, materializability is settled here: missing
-        chunks fetch now, so an accepted hit can always be applied and
-        (if an upload or export later demands it) reconstituted."""
-        from makisu_tpu.cache.manager import CacheMiss, decode_entry
+        chunks fetch now AND the recorded gzip identity must be
+        replayable in this process, so an accepted hit can always be
+        applied and (if an upload or export later demands it)
+        reconstituted byte-identically. An entry whose compression
+        backend we lack falls through to the blob route, whose HEAD
+        check degrades an unmaterializable hit to a miss at pull time —
+        never to a failed build after execution was already skipped."""
+        from makisu_tpu.cache.manager import CacheMiss, \
+            decode_entry_full
         raw = manager._get_raw(cache_id)
         if raw is None:
             raise CacheMiss(cache_id)
-        pair, chunks = decode_entry(raw)
+        pair, chunks, gz_backend = decode_entry_full(raw)
         if pair is None:
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not manager.store.layers.exists(hex_digest) and chunks:
-            triples = [tuple(c) for c in chunks]
-            if chunk_store.ensure_available(triples):
+            if not tario.backend_id_usable(gz_backend):
+                log.info("cache hit %s: gzip backend %r not replayable "
+                         "here; trying the blob route", cache_id,
+                         gz_backend)
+            elif chunk_store.ensure_available(
+                    [tuple(c) for c in chunks]):
                 with manager._lock:
                     manager._lazy[hex_digest] = raw
                 log.info("cache hit %s -> %s (lazy: %d chunks "
-                         "available)", cache_id, hex_digest,
-                         len(triples))
+                         "available)", cache_id, hex_digest, len(chunks))
+                if not manager.lazy_enabled():
+                    # Kill switch (MAKISU_TPU_LAZY_CACHE=0) applies to
+                    # the chunk route too: reconstitute the blob now so
+                    # disabling lazy pulls restores eager materialization
+                    # everywhere, as manager.py documents.
+                    manager.materialize(hex_digest)
                 return pair
-            log.info("cache hit %s: chunks incomplete; trying the "
-                     "blob route", cache_id)
+            else:
+                log.info("cache hit %s: chunks incomplete; trying the "
+                         "blob route", cache_id)
+        # The blob route re-reads the entry; seed the build-local memory
+        # tier so the fall-through costs no second KV round trip.
+        with manager._lock:
+            manager._mem.setdefault(cache_id, raw)
         return inner_pull(cache_id)
 
     # -- lazy materialization routes --------------------------------------
 
     def _lazy_entry(hex_digest):
-        from makisu_tpu.cache.manager import decode_entry, \
-            entry_gzip_backend
+        from makisu_tpu.cache.manager import decode_entry_full
         with manager._lock:
             raw = manager._lazy.get(hex_digest)
         if raw is None:
             return None, None, None
-        pair, chunks = decode_entry(raw)
-        return pair, chunks, entry_gzip_backend(raw)
+        return decode_entry_full(raw)
 
     inner_materialize = manager.materialize
 

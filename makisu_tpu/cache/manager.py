@@ -53,22 +53,27 @@ def encode_entry(pair: DigestPair | None,
     return json.dumps(entry, separators=(",", ":"))
 
 
-def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
+def decode_entry_full(raw: str) -> tuple[DigestPair | None, list,
+                                         str | None]:
+    """One-parse decode: (pair, chunks, gzip backend id). A big layer's
+    entry carries its whole chunk triple array (multi-MB JSON at 100k
+    chunks), so the hot pull path must not parse it twice just to read
+    two different keys."""
     if raw == EMPTY_ENTRY:
-        return None, []
+        return None, [], None
     entry = json.loads(raw)
     pair = DigestPair(
         tar_digest=Digest(entry["tar"]),
         gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, entry["size"],
                                    Digest(entry["gzip"])))
-    return pair, entry.get("chunks", [])
+    return pair, entry.get("chunks", []), entry.get("gz")
 
 
-def entry_gzip_backend(raw: str) -> str | None:
-    """Gzip backend id recorded in a cache entry (None for legacy)."""
-    if raw == EMPTY_ENTRY:
-        return None
-    return json.loads(raw).get("gz")
+def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
+    pair, chunks, _ = decode_entry_full(raw)
+    return pair, chunks
+
+
 
 
 class CacheManager:

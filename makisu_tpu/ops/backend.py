@@ -37,6 +37,17 @@ DEFAULT_TIMEOUT_SECONDS = 180.0
 # re-probed within minutes.
 DEFAULT_CACHE_TTL_SECONDS = 900.0
 
+# Env prefixes that identify a device attachment (endpoint/topology
+# config). Shared signal: the wedge-verdict key folds their values in,
+# and the worker's warm-probe gate checks their presence.
+ATTACHMENT_ENV_PREFIXES = ("TPU_", "LIBTPU_", "AXON_")
+# Attachment vars that are per-PROCESS, not per-attachment: folding
+# these into the verdict key would give every worker process a unique
+# key and silently defeat cross-process verdict sharing (each process
+# would re-pay the full bounded wait on the same wedged tunnel).
+ATTACHMENT_ENV_EXCLUDE = ("TPU_PROCESS_PORT", "TPU_WORKER_ID",
+                          "TPU_VISIBLE_DEVICES")
+
 _lock = threading.Lock()
 _done = threading.Event()
 _result: list = [None]  # [None] until the probe thread finishes;
@@ -44,6 +55,7 @@ _result: list = [None]  # [None] until the probe thread finishes;
 _started = False
 _probe_start = 0.0  # monotonic time the probe thread was started
 _timed_out = False  # a full bounded wait already elapsed once
+_grace_spent = False  # the cached-verdict grace already elapsed once
 
 
 def _probe() -> None:
@@ -76,7 +88,9 @@ def init_timeout() -> float:
 # (180s) before degrading — a build farm restarting workers pays that
 # per process (r3 verdict, weak #4). The first process to time out
 # writes a small verdict file; later processes see a fresh verdict and
-# degrade in <1s. The file self-expires (TTL) and is deleted by any
+# degrade within the short grace window (_grace_seconds, default 2s —
+# long enough for a HEALTHY backend's own probe to override stale
+# hearsay). The file self-expires (TTL) and is deleted by any
 # process whose probe succeeds, so a revived tunnel is picked up within
 # one TTL at worst — and immediately by processes whose own background
 # probe thread completes.
@@ -97,7 +111,35 @@ def _cache_path() -> str:
 
 
 def _platform_key() -> str:
-    return os.environ.get("JAX_PLATFORMS", "(default)")
+    """Identity of the device attachment a wedge verdict applies to.
+    JAX_PLATFORMS alone under-keys it — two attachments (say, distinct
+    tunnel endpoints) sharing /tmp and a platform name would share
+    verdicts — so every TPU_*/LIBTPU_*/AXON_* env var (where endpoint
+    and topology configuration lives) folds into the key. A process
+    whose attachment differs in any of them never inherits another's
+    wedge. Hashed before it leaves the process: the raw values
+    (endpoints, tunnel init args) must not land in a world-readable
+    temp file."""
+    import hashlib
+    parts = [os.environ.get("JAX_PLATFORMS", "(default)")]
+    parts += sorted(
+        f"{k}={v}" for k, v in os.environ.items()
+        if k.startswith(ATTACHMENT_ENV_PREFIXES)
+        and k not in ATTACHMENT_ENV_EXCLUDE)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
+
+
+def _grace_seconds() -> float:
+    """How long a process honors its OWN probe before trusting another
+    process's cached wedge verdict (MAKISU_TPU_PROBE_GRACE). A healthy
+    backend whose tunnel was fixed minutes ago initializes well within
+    this window, so a stale verdict can't condemn it to the XLA path
+    for a whole TTL; a genuinely wedged one costs followers only these
+    few seconds instead of the full bounded wait."""
+    try:
+        return float(os.environ.get("MAKISU_TPU_PROBE_GRACE", "2.0"))
+    except ValueError:
+        return 2.0
 
 
 def _read_cached_wedge() -> str | None:
@@ -113,6 +155,12 @@ def _read_cached_wedge() -> str | None:
         if age < 0 or age > ttl:
             return None
         if rec.get("platforms") != _platform_key():
+            # Not silent: "no verdict" and "verdict for a different
+            # attachment" are different situations — the latter means
+            # this process pays its own bounded wait by design.
+            from makisu_tpu.utils import logging as _log
+            _log.debug("ignoring wedge verdict for a different "
+                       "attachment (pid %s)", rec.get("pid"))
             return None
         return (f"backend init wedged {age:.0f}s ago in another process "
                 f"(pid {rec.get('pid')}: {rec.get('detail', '?')})")
@@ -224,9 +272,24 @@ def backend_ready(timeout: float | None = None) -> str | None:
         return "backend init still pending (tunnel wedged?)"
     cached = _read_cached_wedge()
     if cached is not None:
-        # Another process already paid the bounded wait for this wedge;
-        # degrade instantly. Our own probe thread keeps running, so a
-        # revived tunnel is still picked up by later sessions.
+        # Another process already paid the bounded wait for this wedge —
+        # but give our OWN probe a short grace first: a verdict can
+        # outlive the wedge it recorded (tunnel fixed mid-TTL), and a
+        # healthy fast-initializing backend must not be condemned to
+        # the degraded path by stale hearsay. The grace is charged ONCE
+        # per process (a 40-layer build must not pay it per
+        # ChunkSession); after that, degrade instantly. Our probe
+        # thread keeps running either way, so a slower revival is still
+        # picked up by later sessions in this process.
+        global _grace_spent
+        with _lock:
+            if _grace_spent:
+                return cached
+            _grace_spent = True
+        grace = min(_grace_seconds(),
+                    max(0.0, (_probe_start + timeout) - time.monotonic()))
+        if grace > 0 and _done.wait(grace):
+            return None if _result[0] == "ok" else _result[0]
         return cached
     remaining = (_probe_start + timeout) - time.monotonic()
     if remaining > 0 and _done.wait(remaining):

@@ -80,6 +80,47 @@ def gzip_backend_id(level: int | None = None,
     return f"zlib-{level}"
 
 
+def parse_backend_id(backend_id: str) -> tuple[str, int, int]:
+    """THE parse of the backend-id wire format — ``zlib-<level>`` or
+    ``pgzip-<level>-<block>`` — shared by acceptance
+    (backend_id_usable) and replay (gzip_writer) so the two can never
+    drift: an id accepted at pull time is definitionally one replay can
+    parse. Raises ValueError on malformed or out-of-range ids; returns
+    (backend, level, block) with block 0 for zlib."""
+    parts = backend_id.split("-")
+    _validate_backend(parts[0])
+    level = int(parts[1])
+    if not 0 <= level <= 9:  # zlib's valid level range
+        raise ValueError(f"gzip level {level} out of range in "
+                         f"{backend_id!r}")
+    block = 0
+    if parts[0] == "pgzip":
+        block = int(parts[2])
+        if block <= 0:
+            raise ValueError(f"pgzip block {block} invalid in "
+                             f"{backend_id!r}")
+    return parts[0], level, block
+
+
+def backend_id_usable(backend_id: str | None) -> bool:
+    """True when a recorded backend id can be replayed by gzip_writer in
+    THIS process — known backend name, well-formed level/block, and (for
+    pgzip) the native library present. Cache routes that promise future
+    reconstitution (chunk dedup's lazy hits) consult this up front so an
+    entry written by a host with a backend we lack degrades to the blob
+    route at pull time, not to a failed build at export time. ``None``
+    (legacy entry with no recorded identity) is NOT replayable: the
+    producing settings are unknown, so a byte-identical rebuild cannot
+    be promised."""
+    if backend_id is None:
+        return False
+    try:
+        parse_backend_id(backend_id)
+    except (ValueError, IndexError):
+        return False
+    return True
+
+
 def make_backend_id(backend: str, level_name: str) -> str:
     """Validate a (backend, level) flag pair into a backend id string —
     the per-build compression identity threaded through BuildContext, so
@@ -157,11 +198,9 @@ def gzip_writer(fileobj: BinaryIO, level: int | None = None,
     backend = _gzip_backend
     block = _PGZIP_BLOCK
     if backend_id is not None:
-        parts = backend_id.split("-")
-        backend = parts[0]
-        level = int(parts[1])
+        backend, level, parsed_block = parse_backend_id(backend_id)
         if backend == "pgzip":
-            block = int(parts[2])
+            block = parsed_block
     if backend == "pgzip":
         from makisu_tpu.native import PgzipWriter
         return PgzipWriter(fileobj, level=level, block_size=block)

@@ -108,6 +108,36 @@ def _effective_flags(argv: list[str]) -> dict:
     return out
 
 
+def _warm_probe_wanted() -> bool:
+    """Whether worker startup should begin JAX backend init eagerly.
+    Explicit MAKISU_TPU_WORKER_WARM_PROBE=1/0 wins; otherwise probe
+    exactly when JAX_PLATFORMS names a non-cpu platform or an
+    attachment env var is present — the configurations where the probe
+    buys wedge detection and the exclusive-device-acquisition side
+    effect is intended. Known limitation: a host where plugin discovery
+    finds an accelerator with ZERO env configuration gates off (there
+    is no signal to distinguish it from a cpu-only host without paying
+    the acquisition we're avoiding); such deployments set
+    MAKISU_TPU_WORKER_WARM_PROBE=1 — the gated-off path logs a hint."""
+    forced = os.environ.get("MAKISU_TPU_WORKER_WARM_PROBE")
+    if forced is not None:
+        return forced == "1"
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        return platforms.lower() != "cpu"
+    # JAX_PLATFORMS unset: default platform discovery may still find an
+    # accelerator. The attachment env vars (the same signal the probe's
+    # wedge-cache key uses) say whether one is configured.
+    from makisu_tpu.ops.backend import ATTACHMENT_ENV_PREFIXES
+    from makisu_tpu.utils import logging as log
+    if any(k.startswith(ATTACHMENT_ENV_PREFIXES) for k in os.environ):
+        return True
+    log.info("warm probe gated off (no device platform configured); "
+             "set MAKISU_TPU_WORKER_WARM_PROBE=1 if this host has an "
+             "accelerator via default discovery")
+    return False
+
+
 class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     allow_reuse_address = True
     daemon_threads = True
@@ -127,9 +157,17 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # time the first build's ChunkSession consults backend_ready(),
         # a healthy backend has initialized and a wedged one charges the
         # build only the remaining probe budget — builds never pay a
-        # fresh full bounded wait each (r3 verdict, weak #4).
-        from makisu_tpu.ops import backend as _backend
-        _backend.warm_probe()
+        # fresh full bounded wait each (r3 verdict, weak #4). Gated:
+        # jax backend init ACQUIRES the accelerator (a TPU attaches
+        # exclusively to this process), which a worker serving only
+        # cpu-hasher builds must not do. MAKISU_TPU_WORKER_WARM_PROBE=
+        # 1/0 forces it; the default probes only when JAX_PLATFORMS
+        # names a non-cpu platform (i.e. a device is configured for
+        # this process at all). A gated-off worker still initializes
+        # lazily on the first build that asks for the tpu hasher.
+        if _warm_probe_wanted():
+            from makisu_tpu.ops import backend as _backend
+            _backend.warm_probe()
         # Builds sharing a --root or --storage directory would race on
         # the filesystem; those (and only those) serialize.
         self._path_locks: dict[str, threading.Lock] = {}

@@ -196,6 +196,128 @@ def test_lazy_cache_disabled_restores_eager_pull(tmp_path, monkeypatch):
     assert store_b.layers.exists(m2.layers[0].digest.hex())
 
 
+def test_lazy_disabled_applies_to_chunk_route(tmp_path, monkeypatch):
+    """MAKISU_TPU_LAZY_CACHE=0 with chunk dedup attached: the hit is
+    still chunk-served (no blob transfer) but materializes EAGERLY at
+    pull time, honoring the documented kill switch (r4 advice, low
+    #2)."""
+    import numpy as np
+    monkeypatch.setenv("MAKISU_TPU_LAZY_CACHE", "0")
+    payload = np.random.default_rng(7).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    chunk_root = tmp_path / "chunks"
+    manifest_a, _, _ = build(tmp_path, "a", kv, chunk_root,
+                             "store-a", payload)
+    # Builder B, same KV + chunk root: hits the chunk route.
+    ctx_dir = tmp_path / "ctx-a"
+    root = tmp_path / "root-b"
+    root.mkdir()
+    store_b = ImageStore(str(tmp_path / "store-b"))
+    ctx = BuildContext(str(root), str(ctx_dir), store_b,
+                       hasher=TPUHasher(), sync_wait=0.0)
+    mgr = CacheManager(kv, store_b)
+    attach_chunk_dedup(mgr, str(chunk_root))
+    stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+    plan = BuildPlan(ctx, ImageName("", "t/dedup", "b"), [], mgr, stages,
+                     allow_modify_fs=False, force_commit=True)
+    manifest_b = plan.execute()
+    assert [str(l.digest) for l in manifest_b.layers] == \
+        [str(l.digest) for l in manifest_a.layers]
+    # Eager: the blob exists locally right after the build, with no
+    # materialize_pending() call — reconstituted from chunks at pull.
+    assert store_b.layers.exists(manifest_b.layers[0].digest.hex())
+
+
+def test_unusable_gzip_backend_degrades_to_miss_at_pull(tmp_path):
+    """A cache entry recording a compression backend THIS process
+    cannot replay must not be accepted on the chunk route: byte-exact
+    reconstitution is unpromisable, so the pull falls to the blob
+    route, whose HEAD check degrades a blobless hit to a miss — the
+    build re-executes instead of failing later at export/push time
+    (r4 advice, medium)."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.storage import ImageStore as IS
+
+    payload = np.random.default_rng(9).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "blob.bin").write_bytes(payload)
+    chunk_root = tmp_path / "chunks"
+
+    def one_builder(tag, store_name):
+        root = tmp_path / f"root-{tag}"
+        root.mkdir(exist_ok=True)
+        store = IS(str(tmp_path / store_name))
+        client = RegistryClient(store, "registry.test", "cache/gzb",
+                                transport=fixture)
+        ctx = BuildContext(str(root), str(ctx_dir), store,
+                           hasher=TPUHasher(), sync_wait=0.0)
+        mgr = CacheManager(kv, store, registry_client=client)
+        attach_chunk_dedup(mgr, str(chunk_root))
+        stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+        plan = BuildPlan(ctx, ImageName("", "t/gzb", tag), [], mgr,
+                         stages, allow_modify_fs=False,
+                         force_commit=True)
+        manifest = plan.execute()
+        mgr.wait_for_push()
+        return manifest, store, mgr
+
+    manifest_a, _, _ = one_builder("a", "store-a")
+    # The layer blob was never pushed to the registry — only chunks
+    # (background push) and KV entries exist. Sabotage every entry's
+    # recorded gzip identity to a backend no process has.
+    with kv._lock:
+        for key, raw in list(kv._data.items()):
+            try:
+                entry = json.loads(raw)
+            except json.JSONDecodeError:
+                continue  # EMPTY sentinel
+            if isinstance(entry, dict) and "gz" in entry:
+                entry["gz"] = "zstd-6"
+                kv._data[key] = json.dumps(entry,
+                                           separators=(",", ":"))
+    # Builder B: chunks are all local (shared root), but the entry is
+    # unreplayable and the registry lacks the blob → miss → re-execute.
+    manifest_b, store_b, mgr_b = one_builder("b", "store-b")
+    assert [str(l.digest) for l in manifest_b.layers] == \
+        [str(l.digest) for l in manifest_a.layers]
+    # Because the step re-executed, the blob is locally committed and
+    # every export path works — nothing deferred onto a promise the
+    # process can't keep.
+    mgr_b.materialize_pending()
+    assert store_b.layers.exists(manifest_b.layers[0].digest.hex())
+
+
+def test_ensure_available_fetches_repeated_digest_once(tmp_path):
+    """A digest appearing at several offsets in one layer fetches once,
+    not once per occurrence (r4 advice, low #3)."""
+    from makisu_tpu.docker.image import Digest
+
+    store = ChunkStore(str(tmp_path / "chunks"))
+    fetched = []
+
+    class CountingRegistry:
+        def pull_layer(self, digest):
+            fetched.append(digest.hex())
+            store.put(digest.hex(), b"x" * 10)
+
+    import hashlib as hl
+    hex_digest = hl.sha256(b"x" * 10).hexdigest()
+    store.registry = CountingRegistry()
+    store._fetch_remote = (
+        lambda h: (store.registry.pull_layer(Digest.from_hex(h)), True)[1])
+    chunks = [(0, 10, hex_digest), (10, 10, hex_digest),
+              (20, 10, hex_digest)]
+    assert store.ensure_available(chunks)
+    assert fetched == [hex_digest]
+
+
 def test_chunk_coverage_after_small_edit(tmp_path):
     """Insert bytes near the front of a large file: most chunk bytes must
     be reusable (the >=3x warm-hit-rate story vs whole-layer caching)."""
