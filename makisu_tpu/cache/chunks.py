@@ -31,9 +31,37 @@ from makisu_tpu.utils import logging as log
 # uncompressed tar-stream slices, not gzip layers).
 CHUNK_MEDIA_TYPE = "application/vnd.makisu-tpu.chunk.v1"
 
+# A pack is the wire/registry form of many chunks: the concatenation of
+# a layer's NEWLY-ADDED chunk bytes, carved back into individual chunks
+# by the consumer. Chunks average ~8KiB (dedup granularity wants them
+# small); shipping each as its own registry blob costs one HTTP round
+# trip per 8KiB — a 4GB layer would be ~500k PUTs, and round trips, not
+# bytes, dominate. Packs amortize that to one request per ~8MB while
+# the LOCAL store keeps chunk granularity (fingerprints, dedup, and
+# reconstitution are unchanged).
+PACK_MEDIA_TYPE = "application/vnd.makisu-tpu.chunkpack.v1"
+
 # Chunks per pin manifest: ~140 bytes/descriptor keeps each manifest
 # near 2.8MB, under distribution's 4MiB payload cap.
 PIN_SHARD_CHUNKS = 20_000
+
+
+def packs_enabled() -> bool:
+    """MAKISU_TPU_CHUNK_PACKS=0 restores per-chunk blob pushes (debug /
+    registries that mishandle large opaque blobs)."""
+    return os.environ.get("MAKISU_TPU_CHUNK_PACKS", "1") == "1"
+
+
+def pack_target_bytes() -> int:
+    """Target pack size (MAKISU_TPU_PACK_TARGET_MB, default 8MB): large
+    enough that request overhead amortizes, small enough that a
+    consumer's whole-pack fetch over-reads little and HEAD-skip dedup
+    between successive pushes keeps useful granularity."""
+    try:
+        return int(float(os.environ.get(
+            "MAKISU_TPU_PACK_TARGET_MB", "8")) * 1e6)
+    except ValueError:
+        return 8_000_000
 
 
 def _skip(stream, nbytes: int) -> None:
@@ -113,6 +141,16 @@ class ChunkStore:
         tag lifecycle."""
         if self.registry is None or not chunks:
             return
+        self._pin_shards(layer_hex,
+                         [(length, hex_digest)
+                          for _, length, hex_digest in chunks],
+                         CHUNK_MEDIA_TYPE, "makisu-chunks")
+
+    def _pin_shards(self, layer_hex: str,
+                    blobs: list[tuple[int, str]],
+                    media_type: str, tag_prefix: str) -> None:
+        """Shared pin machinery: tag one or more manifests referencing
+        ``blobs`` ((length, hex) pairs) so the registry's GC sees them."""
         from makisu_tpu.docker.image import (
             MEDIA_TYPE_CONFIG,
             Descriptor,
@@ -128,14 +166,14 @@ class ChunkStore:
         config_desc = Descriptor(MEDIA_TYPE_CONFIG, len(config_blob),
                                  Digest.from_hex(config_hex))
         for shard_index, start in enumerate(
-                range(0, len(chunks), PIN_SHARD_CHUNKS)):
-            shard = chunks[start:start + PIN_SHARD_CHUNKS]
+                range(0, len(blobs), PIN_SHARD_CHUNKS)):
+            shard = blobs[start:start + PIN_SHARD_CHUNKS]
             manifest = DistributionManifest(
                 config=config_desc,
-                layers=[Descriptor(CHUNK_MEDIA_TYPE, length,
+                layers=[Descriptor(media_type, length,
                                    Digest.from_hex(hex_digest))
-                        for _, length, hex_digest in shard])
-            tag = f"makisu-chunks-{layer_hex[:40]}"
+                        for length, hex_digest in shard])
+            tag = f"{tag_prefix}-{layer_hex[:40]}"
             if start:
                 tag += f"-{shard_index}"
             self._push_pin_manifest(tag, manifest, shard)
@@ -153,7 +191,7 @@ class ChunkStore:
             # sweeping up to PIN_SHARD_CHUNKS network round-trips.
             if b"BLOB_UNKNOWN" not in e.body:
                 raise
-            for _, _, hex_digest in shard:
+            for _, hex_digest in shard:
                 self.push_remote(hex_digest)
             self.registry.push_manifest(tag, manifest)
 
@@ -212,8 +250,103 @@ class ChunkStore:
                 pass
         return added
 
+    def build_packs(self, layer_blob_path: str,
+                    chunks: list[tuple[int, int, str]],
+                    added: list[str],
+                    ) -> list[tuple[str, list[int]]]:
+        """Group a layer's newly-added chunk bytes into pack blobs in
+        the local CAS (push_packs uploads them; drop_local_packs cleans
+        up). Returns ``[(pack_hex, [chunk_index, ...]), ...]`` — the
+        mapping the cache entry records so consumers can locate any
+        added chunk inside a pack (offset = sum of the lengths of the
+        pack's preceding members, in index order).
+
+        One streaming pass over the gzip blob, like index_layer: bytes
+        of non-added chunks are skipped, added bytes accumulate into
+        ~pack_target_bytes() buffers, so peak memory is one pack."""
+        added_set = set(added)
+        target = pack_target_bytes()
+        packs: list[tuple[str, list[int]]] = []
+        buf = bytearray()
+        members: list[int] = []
+        packed: set[str] = set()
+
+        def flush() -> None:
+            nonlocal buf, members
+            if not members:
+                return
+            pack_hex = hashlib.sha256(bytes(buf)).hexdigest()
+            if not self.cas.exists(pack_hex):
+                self.cas.write_bytes(pack_hex, bytes(buf))
+            packs.append((pack_hex, members))
+            buf = bytearray()
+            members = []
+
+        with open(layer_blob_path, "rb") as raw:
+            stream = gzip_mod.GzipFile(fileobj=raw, mode="rb")
+            pos = 0
+            for i, (offset, length, hex_digest) in enumerate(chunks):
+                if offset < pos:
+                    raise ValueError(
+                        f"chunk list not offset-sorted at {offset}")
+                _skip(stream, offset - pos)
+                if hex_digest in added_set and hex_digest not in packed:
+                    data = stream.read(length)
+                    if len(data) != length:
+                        raise ValueError("layer stream truncated")
+                    packed.add(hex_digest)
+                    buf += data
+                    members.append(i)
+                    if len(buf) >= target:
+                        flush()
+                else:
+                    _skip(stream, length)
+                pos = offset + length
+        flush()
+        return packs
+
+    def push_packs(self, packs: list[tuple[str, list[int]]]) -> None:
+        for pack_hex, _ in packs:
+            self.registry.push_layer(Digest.from_hex(pack_hex))
+
+    def pin_packs(self, layer_hex: str,
+                  packs: list[tuple[str, list[int]]]) -> None:
+        """Pin pack blobs against registry GC (same tag scheme as
+        pin_remote, PACK media type). Only the packs THIS layer pushed
+        are pinned: chunks reused from earlier layers live in the
+        earlier layers' packs under the earlier layers' pins — retiring
+        those pins degrades later consumers to the blob route, never to
+        a broken build."""
+        if self.registry is None or not packs:
+            return
+        # Distinct tag namespace from pin_remote's: a mixed fleet (one
+        # builder with packs, one without) pinning the same layer must
+        # not have the second pin's tag overwrite — and thereby unpin —
+        # the first route's blobs.
+        self._pin_shards(layer_hex,
+                         [(self.cas.size(pack_hex), pack_hex)
+                          for pack_hex, _ in packs],
+                         PACK_MEDIA_TYPE, "makisu-packs")
+
+    def drop_local_packs(self,
+                         packs: list[tuple[str, list[int]]]) -> None:
+        """Packs are a wire format; the local CAS keeps chunks
+        individually. Called after push+pin (the BLOB_UNKNOWN retry in
+        _push_pin_manifest re-uploads from the CAS, so packs must
+        outlive the pin). A single-member pack's bytes ARE its chunk's
+        bytes — same digest, same CAS entry — so deleting it would
+        delete the chunk; those stay."""
+        for pack_hex, members in packs:
+            if len(members) == 1:
+                continue
+            try:
+                self.cas.delete(pack_hex)
+            except OSError:
+                pass
+
     def ensure_available(self,
-                         chunks: list[tuple[int, int, str]]) -> bool:
+                         chunks: list[tuple[int, int, str]],
+                         packs: list | None = None) -> bool:
         """True when every chunk is local after this call. The local
         scan is one stat per chunk; the misses (the NOVEL fraction
         after an incremental edit — this is the wire transfer chunk
@@ -227,10 +360,143 @@ class ChunkStore:
             return True
         if self.registry is None:
             return False
+        if packs:
+            missing, mapped_failed = self._fetch_from_packs(
+                chunks, packs, missing)
+            if not missing and not mapped_failed:
+                return True
+            if mapped_failed:
+                # Pack-mapped chunks were never pushed as individual
+                # blobs: a per-chunk fallback for them is a guaranteed
+                # 404 per chunk (~100k futile round trips on a big
+                # layer). Their pack is gone/corrupt — report
+                # unavailable so the pull degrades to the blob route.
+                return False
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(8) as pool:
             ok = list(pool.map(self._fetch_remote, missing))
         return all(ok)
+
+    # Coalesce needed spans within a pack when the gap between them is
+    # under this: one ranged GET fetching a few spare KiB beats two
+    # round trips.
+    PACK_RUN_GAP = 128 * 1024
+    # Above this needed-bytes fraction, ranged GETs stop paying: pull
+    # the whole pack in one request.
+    PACK_WHOLE_FETCH_FRACTION = 0.5
+
+    def _fetch_from_packs(self, chunks, packs,
+                          missing: list[str],
+                          ) -> tuple[list[str], bool]:
+        """Fetch missing chunks via their pack blobs, transferring only
+        the spans that are actually missing: needed members coalesce
+        into runs (gap <= PACK_RUN_GAP) served by HTTP Range requests,
+        and a pack mostly-needed (> PACK_WHOLE_FETCH_FRACTION) or on a
+        registry without Range support transfers whole. Either way the
+        wire cost is ~the novel fraction in bytes and ~the novel-REGION
+        count in round trips — never one request per ~8KiB chunk.
+        Carved members are digest-verified before the CAS stores them.
+        Returns (digests not mapped to any pack — still eligible for
+        the per-chunk fallback, mapped_failed — True when a mapped
+        chunk could not be produced because its pack is unavailable or
+        corrupt; those never exist as individual blobs, so the caller
+        must degrade, not retry them one by one)."""
+        locate: dict[str, tuple[str, int, int]] = {}
+        pack_sizes: dict[str, int] = {}
+        pack_member_counts: dict[str, int] = {}
+        for pack_hex, members in packs:
+            off = 0
+            for i in members:
+                try:
+                    _, length, hex_digest = chunks[i]
+                except (IndexError, TypeError, ValueError):
+                    return missing, False  # malformed mapping
+                locate.setdefault(hex_digest, (pack_hex, off, length))
+                off += length
+            pack_sizes[pack_hex] = off
+            pack_member_counts[pack_hex] = len(members)
+        by_pack: dict[str, list[str]] = {}
+        for hex_digest in missing:
+            if hex_digest in locate:
+                by_pack.setdefault(locate[hex_digest][0],
+                                   []).append(hex_digest)
+        got: set[str] = set()
+        n_requests = 0
+        for pack_hex, wanted in by_pack.items():
+            spans = sorted((locate[h][1], locate[h][2], h)
+                           for h in wanted)
+            needed = sum(length for _, length, _ in spans)
+            pack_size = pack_sizes[pack_hex]
+
+            def carve(data: bytes, base: int, members) -> None:
+                """Verify+store members whose bytes lie inside data
+                (pack bytes [base, base+len(data)))."""
+                for off, length, hex_digest in members:
+                    piece = data[off - base:off - base + length]
+                    if len(piece) != length:
+                        continue
+                    try:
+                        self.put(hex_digest, piece)
+                        got.add(hex_digest)
+                    except ValueError as e:
+                        log.warning("pack %s member %s corrupt: %s",
+                                    pack_hex, hex_digest, e)
+
+            ranged_ok = (self.registry is not None
+                         and needed <= pack_size
+                         * self.PACK_WHOLE_FETCH_FRACTION)
+            if ranged_ok:
+                runs: list[list] = []
+                for span in spans:
+                    if (runs and span[0] - (runs[-1][-1][0]
+                                            + runs[-1][-1][1])
+                            <= self.PACK_RUN_GAP):
+                        runs[-1].append(span)
+                    else:
+                        runs.append([span])
+                for run in runs:
+                    start = run[0][0]
+                    end = run[-1][0] + run[-1][1]
+                    got_range = self.registry.pull_blob_range(
+                        Digest.from_hex(pack_hex), start, end)
+                    n_requests += 1
+                    if got_range is None:
+                        ranged_ok = False  # registry can't: whole pack
+                        break
+                    kind, data = got_range
+                    if kind == "partial":
+                        carve(data, start, run)
+                    else:  # server ignored Range: whole blob in hand
+                        carve(data, 0, spans)
+                        break
+            if not ranged_ok:
+                if not self._fetch_remote(pack_hex):
+                    log.debug("pack %s unavailable; per-chunk fallback "
+                              "for %d chunks", pack_hex, len(wanted))
+                    continue
+                n_requests += 1
+                single = pack_member_counts[pack_hex] == 1
+                try:
+                    with self.cas.open(pack_hex) as f:
+                        carve(f.read(), 0, spans)
+                finally:
+                    # A single-member pack IS its chunk (same digest):
+                    # deleting it would delete the chunk just carved.
+                    if not single:
+                        try:
+                            self.cas.delete(pack_hex)
+                        except OSError:
+                            pass
+        if got:
+            log.info("fetched %d/%d missing chunks from %d pack(s) in "
+                     "%d request(s)", len(got), len(missing),
+                     len(by_pack), n_requests)
+        unmapped = [h for h in missing
+                    if h not in got and h not in locate]
+        mapped_failed = any(h in locate and h not in got
+                            and not self.cas.exists(h)
+                            for h in missing)
+        return unmapped, mapped_failed
 
     def coverage(self, chunks: list[tuple[int, int, str]]) -> float:
         """Fraction of the layer's bytes already present as LOCAL
@@ -454,10 +720,17 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 layer_hex = pair.gzip_descriptor.digest.hex()
 
                 def push_chunks(added=added, triples=triples,
-                                layer_hex=layer_hex):
-                    # A layer can introduce thousands of small chunk
-                    # blobs; per-blob round trips dominate, so upload
-                    # on a pool (uploads are independent PUTs).
+                                layer_hex=layer_hex, path=path,
+                                cache_id=cache_id):
+                    if packs_enabled() and added:
+                        if _push_as_packs(added, triples, layer_hex,
+                                          path, cache_id):
+                            return
+                        log.warning("pack push for %s failed; falling "
+                                    "back to per-chunk blobs", cache_id)
+                    # Per-chunk route (packs disabled or failed): one
+                    # blob per chunk, uploaded on a pool since per-blob
+                    # round trips, not bytes, dominate.
                     from concurrent.futures import ThreadPoolExecutor
                     failed = []
 
@@ -480,6 +753,32 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                     except Exception as e:  # noqa: BLE001
                         log.warning("chunk pin for %s failed: %s",
                                     layer_hex, e)
+
+                def _push_as_packs(added, triples, layer_hex, path,
+                                   cache_id) -> bool:
+                    """Wire form: pack blobs (one PUT per ~8MB instead
+                    of per ~8KiB chunk), pinned for GC, with the
+                    chunk->pack mapping recorded back onto the cache
+                    entry so consumers fetch packs, not chunks."""
+                    packs = []
+                    try:
+                        packs = chunk_store.build_packs(path, triples,
+                                                        added)
+                        chunk_store.push_packs(packs)
+                        chunk_store.pin_packs(layer_hex, packs)
+                        manager.set_entry_packs(
+                            cache_id,
+                            [[pack_hex, members]
+                             for pack_hex, members in packs])
+                        log.info("pushed %d chunks as %d pack blob(s) "
+                                 "for %s", len(added), len(packs),
+                                 cache_id)
+                        return True
+                    except Exception as e:  # noqa: BLE001
+                        log.debug("pack push failed: %s", e)
+                        return False
+                    finally:
+                        chunk_store.drop_local_packs(packs)
                 import contextvars
                 import threading
                 # Carry the caller's context so worker-mode log sinks
@@ -509,7 +808,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         raw = manager._get_raw(cache_id)
         if raw is None:
             raise CacheMiss(cache_id)
-        pair, chunks, gz_backend = decode_entry_full(raw)
+        pair, chunks, gz_backend, packs = decode_entry_full(raw)
         if pair is None:
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
@@ -519,7 +818,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                          "here; trying the blob route", cache_id,
                          gz_backend)
             elif chunk_store.ensure_available(
-                    [tuple(c) for c in chunks]):
+                    [tuple(c) for c in chunks], packs):
                 with manager._lock:
                     manager._lazy[hex_digest] = raw
                 log.info("cache hit %s -> %s (lazy: %d chunks "
@@ -547,7 +846,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         with manager._lock:
             raw = manager._lazy.get(hex_digest)
         if raw is None:
-            return None, None, None
+            return None, None, None, None
         return decode_entry_full(raw)
 
     inner_materialize = manager.materialize
@@ -557,7 +856,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         deterministically), registry blob transfer second."""
         if manager.store.layers.exists(hex_digest):
             return manager.store.layers.path(hex_digest)
-        pair, chunks, gz_backend = _lazy_entry(hex_digest)
+        pair, chunks, gz_backend, _packs = _lazy_entry(hex_digest)
         if pair is not None and chunks:
             path = chunk_store.reconstitute_to_path(
                 pair, [tuple(c) for c in chunks], gz_backend=gz_backend)
@@ -588,10 +887,10 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
 
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not manager.store.layers.exists(hex_digest):
-            _, chunks, _ = _lazy_entry(hex_digest)
+            _, chunks, _, packs = _lazy_entry(hex_digest)
             if chunks:
                 triples = [tuple(c) for c in chunks]
-                if chunk_store.ensure_available(triples):
+                if chunk_store.ensure_available(triples, packs):
 
                     @contextlib.contextmanager
                     def _chunk_tar():

@@ -54,23 +54,26 @@ def encode_entry(pair: DigestPair | None,
 
 
 def decode_entry_full(raw: str) -> tuple[DigestPair | None, list,
-                                         str | None]:
-    """One-parse decode: (pair, chunks, gzip backend id). A big layer's
-    entry carries its whole chunk triple array (multi-MB JSON at 100k
-    chunks), so the hot pull path must not parse it twice just to read
-    two different keys."""
+                                         str | None, list]:
+    """One-parse decode: (pair, chunks, gzip backend id, packs). A big
+    layer's entry carries its whole chunk triple array (multi-MB JSON
+    at 100k chunks), so the hot pull path must not parse it twice just
+    to read different keys. ``packs`` maps this layer's newly-pushed
+    chunks to their wire pack blobs ([[pack_hex, [chunk_idx, ...]]];
+    empty for entries from writers that pushed per-chunk)."""
     if raw == EMPTY_ENTRY:
-        return None, [], None
+        return None, [], None, []
     entry = json.loads(raw)
     pair = DigestPair(
         tar_digest=Digest(entry["tar"]),
         gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, entry["size"],
                                    Digest(entry["gzip"])))
-    return pair, entry.get("chunks", []), entry.get("gz")
+    return (pair, entry.get("chunks", []), entry.get("gz"),
+            entry.get("packs", []))
 
 
 def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
-    pair, chunks, _ = decode_entry_full(raw)
+    pair, chunks, _, _ = decode_entry_full(raw)
     return pair, chunks
 
 
@@ -228,8 +231,22 @@ class CacheManager:
                     self.registry.push_layer(pair.gzip_descriptor.digest)
                 for attempt in range(_KV_RETRIES):
                     try:
-                        self.kv.put(cache_id, entry)
-                        return
+                        # Re-read at put time: the chunk-pack thread may
+                        # have enriched the entry (set_entry_packs)
+                        # while the layer blob was uploading. Verify
+                        # after write — kv.put runs outside the lock
+                        # (it's network I/O), so an enrichment landing
+                        # mid-put would be clobbered by our stale value;
+                        # loop until the value we wrote is the value in
+                        # _mem.
+                        while True:
+                            with self._lock:
+                                current = self._mem.get(cache_id, entry)
+                            self.kv.put(cache_id, current)
+                            with self._lock:
+                                if self._mem.get(cache_id,
+                                                 entry) == current:
+                                    return
                     except Exception as e:  # noqa: BLE001
                         log.warning("cache KV put %s failed (try %d): %s",
                                     cache_id, attempt + 1, e)
@@ -243,6 +260,29 @@ class CacheManager:
         t.start()
         with self._lock:
             self._pushes.append(t)
+
+    def set_entry_packs(self, cache_id: str, packs: list) -> None:
+        """Record the chunk->pack mapping on an already-written entry.
+        Pack upload completes in the background after push_cache wrote
+        the entry, so the mapping lands as an update. A consumer racing
+        the update sees an entry without packs and degrades to
+        per-chunk fetch / the blob route — never a broken hit."""
+        with self._lock:
+            raw = self._mem.get(cache_id)
+        if raw in (None, EMPTY_ENTRY):
+            return
+        entry = json.loads(raw)
+        entry["packs"] = packs
+        new_raw = json.dumps(entry, separators=(",", ":"))
+        with self._lock:
+            self._mem[cache_id] = new_raw
+        for attempt in range(_KV_RETRIES):
+            try:
+                self.kv.put(cache_id, new_raw)
+                return
+            except Exception as e:  # noqa: BLE001
+                log.warning("cache KV pack update %s failed (try %d): "
+                            "%s", cache_id, attempt + 1, e)
 
     def wait_for_push(self) -> None:
         with self._lock:

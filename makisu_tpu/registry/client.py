@@ -385,47 +385,11 @@ class RegistryClient:
         hex_digest = Digest(digest).hex()
         if self.store.layers.exists(hex_digest):
             return self.store.layers.path(hex_digest)
-        redirects = (301, 302, 303, 307, 308)
         fd, tmp = tempfile.mkstemp(prefix="blob-")
         os.close(fd)
         try:
-            resp = self._send("GET", f"{self._base()}/blobs/{digest}",
-                              accepted=(200,) + redirects, stream_to=tmp)
-            # Follow redirects (Docker Hub / S3 / GCS-backed registries
-            # offload blob GETs this way); the final target streams the
-            # real blob into tmp. Chains of more than one hop happen in
-            # the wild (distribution behind CDN fronting: 302 → 302 →
-            # 200), so loop with a bound rather than following exactly
-            # one Location. Never consult a redirect response's own
-            # body: it is an HTML stub (Go's http.Redirect writes one
-            # for GET) and must not clobber the blob.
-            current = f"{self._base()}/blobs/{digest}"
-            hops = 0
-            while resp.status in redirects:
-                hops += 1
-                if hops > 5:
-                    raise ValueError(
-                        f"blob {digest}: more than 5 redirect hops")
-                # Relative Locations resolve against the hop that issued
-                # them (a CDN's relative redirect must not bounce back
-                # to the registry origin).
-                from urllib.parse import urljoin
-                location = urljoin(current, resp.header("location"))
-                current = location
-                if self._same_origin(location):
-                    # Same registry: keep auth (and the 401 token dance).
-                    resp = self._send("GET", location,
-                                      accepted=(200,) + redirects,
-                                      stream_to=tmp)
-                else:
-                    # Cross-origin presigned URL (S3/GCS): forwarding
-                    # registry credentials would leak them, and the
-                    # registry-pinned transport must not apply.
-                    resp = send(
-                        self.cdn_transport, "GET", location, {},
-                        retries=self.config.retries,
-                        timeout=self.config.timeout, stream_to=tmp,
-                        accepted=(200,) + redirects)
+            resp = self._get_blob_following_redirects(
+                digest, accepted=(200,), stream_to=tmp)
             if resp.status == 200 and resp.body:
                 # Transport without streaming support (fixtures).
                 with open(tmp, "wb") as f:
@@ -451,6 +415,79 @@ class RegistryClient:
         path = self.pull_layer(digest)
         with open(path, "rb") as f:
             return f.read()
+
+    def _get_blob_following_redirects(self, digest: Digest,
+                                      accepted: tuple[int, ...],
+                                      headers: dict[str, str]
+                                      | None = None,
+                                      stream_to: str | None = None):
+        """THE blob-GET redirect chase, shared by whole-blob and ranged
+        pulls so the two can't drift. Docker Hub / S3 / GCS-backed
+        registries offload blob GETs through redirects, and chains of
+        more than one hop happen in the wild (distribution behind CDN
+        fronting: 302 → 302 → 200), so loop with a bound rather than
+        following exactly one Location. A redirect response's own body
+        is never consulted: it is an HTML stub (Go's http.Redirect
+        writes one for GET) and must not clobber the blob. Same-origin
+        hops keep auth (and the 401 token dance); cross-origin
+        presigned URLs (S3/GCS) go through cdn_transport with no
+        registry credentials — forwarding them would leak them."""
+        redirects = (301, 302, 303, 307, 308)
+        resp = self._send("GET", f"{self._base()}/blobs/{digest}",
+                          headers=headers,
+                          accepted=accepted + redirects,
+                          stream_to=stream_to)
+        current = f"{self._base()}/blobs/{digest}"
+        hops = 0
+        while resp.status in redirects:
+            hops += 1
+            if hops > 5:
+                raise ValueError(
+                    f"blob {digest}: more than 5 redirect hops")
+            # Relative Locations resolve against the hop that issued
+            # them (a CDN's relative redirect must not bounce back to
+            # the registry origin).
+            from urllib.parse import urljoin
+            location = urljoin(current, resp.header("location"))
+            current = location
+            if self._same_origin(location):
+                resp = self._send("GET", location, headers=headers,
+                                  accepted=accepted + redirects,
+                                  stream_to=stream_to)
+            else:
+                resp = send(self.cdn_transport, "GET", location,
+                            dict(headers or {}),
+                            retries=self.config.retries,
+                            timeout=self.config.timeout,
+                            stream_to=stream_to,
+                            accepted=accepted + redirects)
+        return resp
+
+    def pull_blob_range(self, digest: Digest, start: int,
+                        end: int) -> tuple[str, bytes] | None:
+        """GET bytes [start, end) of a blob via an HTTP Range request
+        (chunk-pack consumers fetch only the novel spans of a pack, not
+        the whole blob). Returns ("partial", range_bytes) on 206,
+        ("full", whole_blob) when the server ignored the Range and sent
+        200 (the caller carves what it needs and wastes nothing), or
+        None on failure — callers fall back to a whole-blob pull, so a
+        registry without Range support degrades in bytes, not in
+        correctness. No CAS involvement: a range has no digest of its
+        own to verify, so callers MUST verify whatever they carve out
+        against content digests before storing it (chunks.py does)."""
+        try:
+            resp = self._get_blob_following_redirects(
+                digest, accepted=(200, 206),
+                headers={"Range": f"bytes={start}-{end - 1}"})
+            if resp.status == 206:
+                if len(resp.body) != end - start:
+                    return None
+                return "partial", resp.body
+            return "full", resp.body
+        except Exception as e:  # noqa: BLE001 - range is an optimization
+            log.debug("ranged blob GET %s [%d,%d) failed: %s", digest,
+                      start, end, e)
+            return None
 
     # -- push -------------------------------------------------------------
 

@@ -189,7 +189,15 @@ class RegistryFixture(Transport):
                                 {}, b"")
             if method == "GET":
                 if hex_digest in self.blobs:
-                    return Response(200, {}, self.blobs[hex_digest])
+                    data = self.blobs[hex_digest]
+                    rng = headers.get("Range", "")
+                    m_rng = re.fullmatch(r"bytes=(\d+)-(\d+)", rng)
+                    if m_rng:
+                        start = int(m_rng.group(1))
+                        end = min(int(m_rng.group(2)) + 1, len(data))
+                        if 0 <= start < end:
+                            return Response(206, {}, data[start:end])
+                    return Response(200, {}, data)
                 return Response(404, {}, b"blob unknown")
 
         m = re.fullmatch(r"/v2/(.+)/blobs/uploads/", path)

@@ -82,6 +82,7 @@ class CopyOperation:
         dst = pathutils.join_root(root, self.dst)
         if is_dir_format(self.dst):
             dst += "/"
+        synthesized: list[str] = []
         for src in self.srcs:
             src = eval_symlinks(src, self.src_root)
             src = pathutils.join_root(self.src_root, src)
@@ -94,3 +95,15 @@ class CopyOperation:
                                                    os.path.basename(src)))
             else:
                 copier.copy_file(src, dst)
+            synthesized.extend(copier.created_dirs)
+        # Synthesized ancestors (e.g. /app for COPY . /app/) get epoch
+        # mtime AFTER all writes (each child creation bumped the dir),
+        # matching the epoch-mtime headers MemFS synthesizes for the
+        # same paths — a live timestamp here would make the next scan
+        # diff re-emit the dir into an unrelated layer with the wall
+        # clock in it, breaking layer reproducibility.
+        for d in synthesized:
+            try:
+                os.utime(d, (0, 0))
+            except OSError:
+                pass

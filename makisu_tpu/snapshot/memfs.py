@@ -75,11 +75,10 @@ class FSDiff:
 
 class MemFS:
     def __init__(self, root: str, blacklist: list[str] | None = None,
-                 clock=time.time, sync_wait: float = 1.0) -> None:
+                 sync_wait: float = 1.0) -> None:
         os.lstat(root)  # must exist
         self.root = root
         self.blacklist = list(blacklist or [])
-        self.clock = clock
         self.sync_wait = sync_wait
         hdr = tarinfo_from_stat(root, "", root)
         hdr.name = ""  # "/" itself never appears in layers
@@ -254,7 +253,14 @@ class MemFS:
             hdr = tarfile.TarInfo(pathutils.rel_path(cur))
             hdr.type = tarfile.DIRTYPE
             hdr.mode = last_dir.hdr.mode
-            hdr.mtime = int(self.clock())
+            # Epoch mtime, not the wall clock: a synthesized ancestor
+            # (e.g. /app for COPY . /app/) exists in no source tree, so
+            # any live timestamp would make two builds of identical
+            # inputs differ whenever they straddle a second boundary —
+            # silently breaking the byte-reproducibility COPY layers
+            # promise (and cache/dedup identity with it). Same policy
+            # as heredoc-generated files (steps/add_copy.py).
+            hdr.mtime = 0
             hdr.uid = uid
             hdr.gid = gid
             self._apply_entry(layer.add_header("", cur, hdr))

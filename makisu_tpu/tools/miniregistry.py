@@ -64,6 +64,27 @@ _LIST_TYPES = (
 )
 
 
+def _parse_range(header: str | None, size: int) -> tuple[int, int] | None:
+    """``bytes=a-b`` (inclusive) -> [a, b+1), clamped; None = serve the
+    whole blob (absent/malformed/multi-range — 200 is always a legal
+    answer to a Range request, so unsupported shapes degrade to it)."""
+    if not header or not header.startswith("bytes="):
+        return None
+    spec = header[len("bytes="):]
+    if "," in spec or "-" not in spec:
+        return None
+    first, last = spec.split("-", 1)
+    if not first or not last:  # suffix/open-ended: not needed here
+        return None
+    try:
+        start, end = int(first), int(last) + 1
+    except ValueError:
+        return None
+    if start < 0 or end <= start:
+        return None
+    return start, min(end, size)
+
+
 def _digest_of(data: bytes) -> str:
     return "sha256:" + hashlib.sha256(data).hexdigest()
 
@@ -202,11 +223,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "BLOB_UNKNOWN", "blob unknown to registry",
                         digest)
             return
+        status = 200
         if self.command == "GET":
+            rng = _parse_range(self.headers.get("Range"), len(data))
+            if rng is not None:
+                start, end = rng
+                data = data[start:end]
+                status = 206
             self.st.wire_delay(len(data))
             with self.st.lock:
                 self.st.blob_bytes_out += len(data)
-        self._reply(200, data, {
+        self._reply(status, data, {
             "Content-Type": "application/octet-stream",
             "Docker-Content-Digest": digest,
         })

@@ -49,6 +49,13 @@ class Copier:
         self.blacklist = list(blacklist or [])
         self.dir_owner = dir_owner
         self.file_owner = file_owner
+        # Ancestor dirs this copier synthesized (no source counterpart,
+        # so no mtime to preserve). Callers producing layers timestamp
+        # them deterministically afterwards (CopyOperation.execute) so
+        # the disk state matches the epoch-mtime headers MemFS
+        # synthesizes for the same paths — otherwise the next scan diff
+        # re-emits every such dir with the wall clock in it.
+        self.created_dirs: list[str] = []
 
     def _blacklisted(self, p: str) -> bool:
         return pathutils.is_descendant_of_any(p, self.blacklist)
@@ -77,6 +84,7 @@ class Copier:
             if not os.path.lexists(cur):
                 os.mkdir(cur, 0o755)
                 _chown(cur, 0, 0)
+                self.created_dirs.append(cur)
 
     def _ensure_dir(self, src: str, dst: str, top: bool) -> None:
         """Create/update one destination directory from a source directory."""

@@ -310,3 +310,34 @@ def test_builds_are_reproducible(tmp_path):
         return [str(l.digest) for l in manifest.layers]
 
     assert build_once("one") == build_once("two")
+
+
+def test_synthesized_ancestor_dirs_are_timeless(tmp_path):
+    """COPY . /app/ synthesizes /app from no source tree; its header
+    must carry epoch mtime, not the wall clock — otherwise two builds
+    of identical inputs straddling a second boundary produce different
+    layer bytes (caught live: the reproducibility test above only
+    passed when both builds landed in the same second)."""
+    import tarfile as tf
+
+    ctx_dir = tmp_path / "ctx"
+    ctx_dir.mkdir()
+    (ctx_dir / "app.py").write_text("print('x')\n")
+    root = tmp_path / "root"
+    root.mkdir()
+    store = ImageStore(str(tmp_path / "store"))
+    ctx = BuildContext(str(root), str(ctx_dir), store, sync_wait=0.0)
+    plan = BuildPlan(ctx, ImageName("", "repro/tless", "t"), [],
+                     NoopCacheManager(),
+                     parse_file("FROM scratch\nCOPY . /app/deep/\n"),
+                     allow_modify_fs=False, force_commit=True)
+    manifest = plan.execute()
+    hex_digest = manifest.layers[0].digest.hex()
+    with store.layers.open(hex_digest) as f:
+        with tf.open(fileobj=f, mode="r:gz") as tar:
+            by_name = {m.name.rstrip("/"): m for m in tar.getmembers()}
+    assert by_name["app"].mtime == 0
+    assert by_name["app/deep"].mtime == 0
+    # The real file keeps its source mtime (mtime-preserving copies).
+    assert by_name["app/deep/app.py"].mtime == int(
+        (ctx_dir / "app.py").stat().st_mtime)

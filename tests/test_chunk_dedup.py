@@ -318,6 +318,190 @@ def test_ensure_available_fetches_repeated_digest_once(tmp_path):
     assert fetched == [hex_digest]
 
 
+def _registry_builder(tmp_path, kv, fixture, tag, store_name,
+                      chunk_name, payload, repo="t/packs"):
+    """One registry-attached builder; returns (manifest, store, mgr)."""
+    from makisu_tpu.registry import RegistryClient
+    from makisu_tpu.storage import ImageStore as IS
+
+    ctx_dir = tmp_path / f"ctx-{tag}"
+    ctx_dir.mkdir(exist_ok=True)
+    (ctx_dir / "blob.bin").write_bytes(payload)
+    root = tmp_path / f"root-{tag}"
+    root.mkdir(exist_ok=True)
+    store = IS(str(tmp_path / store_name))
+    client = RegistryClient(store, "registry.test", repo,
+                            transport=fixture)
+    ctx = BuildContext(str(root), str(ctx_dir), store,
+                       hasher=TPUHasher(), sync_wait=0.0)
+    mgr = CacheManager(kv, store, registry_client=client)
+    attach_chunk_dedup(mgr, str(tmp_path / chunk_name))
+    stages = parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n")
+    plan = BuildPlan(ctx, ImageName("", repo, tag), [], mgr, stages,
+                     allow_modify_fs=False, force_commit=True)
+    manifest = plan.execute()
+    mgr.wait_for_push()
+    return manifest, store, mgr
+
+
+def test_pack_wire_format_cuts_round_trips(tmp_path):
+    """Chunks cross the wire grouped into pack blobs: a consumer with
+    NO local chunks fetches a few packs, not one blob per ~8KiB chunk.
+    Round trips, not bytes, dominate small-blob transfer — this is what
+    makes chunk dedup usable at 100k-chunk layer scale."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryFixture
+
+    payload = np.random.default_rng(21).integers(
+        0, 256, size=600_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+
+    # Builder A: pushes entry + packs (~70 chunks at 8KiB avg).
+    m_a, _, _ = _registry_builder(tmp_path, kv, fixture, "a", "store-a",
+                                  "chunks-a", payload)
+    # The pack mapping landed on the KV entry.
+    entries = [json.loads(v) for v in kv._data.values()
+               if isinstance(v, str) and v.startswith("{")]
+    packed = [e for e in entries if e.get("packs")]
+    assert packed, "entry should record the chunk->pack mapping"
+    n_chunks = len(packed[0]["chunks"])
+    assert n_chunks > 20
+    mapped = {i for _, members in packed[0]["packs"] for i in members}
+    assert mapped == set(range(n_chunks))  # first build: all chunks new
+
+    # Builder B: fresh chunk store, shared KV -> must fetch everything.
+    before = len(fixture.requests)
+    m_b, store_b, _ = _registry_builder(tmp_path, kv, fixture, "b",
+                                        "store-b", "chunks-b", payload)
+    assert [str(l.digest) for l in m_b.layers] == \
+        [str(l.digest) for l in m_a.layers]
+    blob_gets = [u for m, u in fixture.requests[before:]
+                 if m == "GET" and "/blobs/sha256:" in u]
+    # One pack (600KB < 8MB target) — not ~70 per-chunk GETs.
+    assert len(blob_gets) <= 3, blob_gets
+    # And the hit is real: the layer applied without the gzip blob.
+    assert not store_b.layers.exists(m_b.layers[0].digest.hex())
+
+
+def test_pack_fetch_verifies_and_degrades_on_corruption(tmp_path):
+    """A corrupt pack must not poison the chunk CAS: members are
+    digest-verified at carve time, corrupt ones stay missing, and the
+    pull degrades to the per-chunk/blob route."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryFixture
+
+    payload = np.random.default_rng(22).integers(
+        0, 256, size=300_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    m_a, _, _ = _registry_builder(tmp_path, kv, fixture, "a", "store-a",
+                                  "chunks-a", payload, repo="t/corrupt")
+    layer_hex = m_a.layers[0].digest.hex()
+    # Push A's blob so the blob route can save the day.
+    from makisu_tpu.registry import RegistryClient
+    from makisu_tpu.storage import ImageStore as IS
+    push_client = RegistryClient(IS(str(tmp_path / "store-a")),
+                                 "registry.test", "t/corrupt",
+                                 transport=fixture)
+    push_client.push_layer(m_a.layers[0].digest)
+    # Corrupt every pack blob in the registry (keep sizes).
+    entries = [json.loads(v) for v in kv._data.values()
+               if isinstance(v, str) and v.startswith("{")]
+    pack_hexes = {p for e in entries for p, _ in e.get("packs", [])}
+    assert pack_hexes
+    for pack_hex in pack_hexes:
+        blob = fixture.blobs[pack_hex]
+        fixture.blobs[pack_hex] = b"\x00" * len(blob)
+
+    # Builder B: pack fetch fails verification -> falls through; the
+    # build must still succeed (blob route) and never cache bad bytes.
+    m_b, _, mgr_b = _registry_builder(tmp_path, kv, fixture, "b",
+                                      "store-b", "chunks-b", payload,
+                                      repo="t/corrupt")
+    assert [str(l.digest) for l in m_b.layers] == \
+        [str(l.digest) for l in m_a.layers]
+    chunk_cas = ChunkStore(str(tmp_path / "chunks-b")).cas
+    for e in entries:
+        for _, _, hex_digest in e.get("chunks", []):
+            if chunk_cas.exists(hex_digest):
+                with chunk_cas.open(hex_digest) as f:
+                    data = f.read()
+                import hashlib as hl
+                assert hl.sha256(data).hexdigest() == hex_digest
+
+
+def test_single_member_pack_aliases_its_chunk_safely(tmp_path):
+    """A pack with one member has the member's own bytes and therefore
+    the member's own DIGEST — pack cleanup must not delete the chunk it
+    aliases (producer side), and a consumer's whole-pack fetch must
+    leave the chunk present."""
+    import gzip as gz
+    import hashlib as hl
+
+    from makisu_tpu.docker.image import Digest
+
+    data = b"q" * 5000
+    chunk_hex = hl.sha256(data).hexdigest()
+    blob = gz.compress(data, mtime=0)
+    blob_path = tmp_path / "layer.gz"
+    blob_path.write_bytes(blob)
+    store = ChunkStore(str(tmp_path / "chunks"))
+    triples = [(0, len(data), chunk_hex)]
+    added = store.index_layer(str(blob_path), triples)
+    assert added == [chunk_hex]
+    packs = store.build_packs(str(blob_path), triples, added)
+    assert len(packs) == 1 and packs[0][0] == chunk_hex  # the alias
+    store.drop_local_packs(packs)
+    assert store.cas.exists(chunk_hex)  # producer kept its chunk
+
+    # Consumer: fresh store; whole-pack fetch (single member = 100%
+    # needed) must store the chunk and not delete it afterwards.
+    consumer = ChunkStore(str(tmp_path / "chunks2"))
+
+    class OneBlobRegistry:
+        def pull_layer(self, digest):
+            assert digest.hex() == chunk_hex
+            consumer.cas.write_bytes(chunk_hex, data)
+
+        def pull_blob_range(self, digest, start, end):
+            return None  # force the whole-pack branch
+
+    consumer.registry = OneBlobRegistry()
+    assert consumer.ensure_available(triples,
+                                     [[chunk_hex, [0]]])
+    assert consumer.cas.exists(chunk_hex)
+
+
+def test_packs_disabled_restores_per_chunk_blobs(tmp_path, monkeypatch):
+    """MAKISU_TPU_CHUNK_PACKS=0: chunks push individually (the v1 wire
+    format) and consumers fetch them individually."""
+    import numpy as np
+
+    from makisu_tpu.registry import RegistryFixture
+
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_PACKS", "0")
+    payload = np.random.default_rng(23).integers(
+        0, 256, size=200_000, dtype=np.uint8).tobytes()
+    kv = MemoryStore()
+    fixture = RegistryFixture()
+    m_a, _, _ = _registry_builder(tmp_path, kv, fixture, "a", "store-a",
+                                  "chunks-a", payload, repo="t/nopack")
+    entries = [json.loads(v) for v in kv._data.values()
+               if isinstance(v, str) and v.startswith("{")]
+    assert not any(e.get("packs") for e in entries)
+    before = len(fixture.requests)
+    m_b, _, _ = _registry_builder(tmp_path, kv, fixture, "b", "store-b",
+                                  "chunks-b", payload, repo="t/nopack")
+    assert [str(l.digest) for l in m_b.layers] == \
+        [str(l.digest) for l in m_a.layers]
+    blob_gets = [u for m, u in fixture.requests[before:]
+                 if m == "GET" and "/blobs/sha256:" in u]
+    assert len(blob_gets) > 10  # one per chunk, the old wire shape
+
+
 def test_chunk_coverage_after_small_edit(tmp_path):
     """Insert bytes near the front of a large file: most chunk bytes must
     be reusable (the >=3x warm-hit-rate story vs whole-layer caching)."""
@@ -457,9 +641,10 @@ def test_chunks_survive_registry_gc(tmp_path):
         return manifest, store, mgr
 
     m1, _, _ = one_builder("a", "store-a", "chunks-a")
-    # A pin manifest exists for the layer.
+    # A pin manifest exists for the layer (pack-route namespace: packs
+    # are the wire format, so the pin references pack blobs).
     layer_hex = m1.layers[0].digest.hex()
-    pin_tag = f"cache/gc:makisu-chunks-{layer_hex[:40]}"
+    pin_tag = f"cache/gc:makisu-packs-{layer_hex[:40]}"
     assert pin_tag in fixture.manifests
     # The layer blob itself is unreferenced (no image manifest was
     # pushed) — GC deletes it. Chunk blobs survive via the pin.
