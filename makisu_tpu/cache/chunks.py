@@ -56,12 +56,15 @@ def pack_target_bytes() -> int:
     """Target pack size (MAKISU_TPU_PACK_TARGET_MB, default 8MB): large
     enough that request overhead amortizes, small enough that a
     consumer's whole-pack fetch over-reads little and HEAD-skip dedup
-    between successive pushes keeps useful granularity."""
+    between successive pushes keeps useful granularity. Floored at 1MB:
+    a target under the chunk size would silently degenerate to one pack
+    per chunk — the per-chunk PUT storm packs exist to eliminate."""
     try:
-        return int(float(os.environ.get(
+        target = int(float(os.environ.get(
             "MAKISU_TPU_PACK_TARGET_MB", "8")) * 1e6)
     except ValueError:
         return 8_000_000
+    return max(target, 1_000_000)
 
 
 def _skip(stream, nbytes: int) -> None:
@@ -250,8 +253,7 @@ class ChunkStore:
                 pass
         return added
 
-    def build_packs(self, layer_blob_path: str,
-                    chunks: list[tuple[int, int, str]],
+    def build_packs(self, chunks: list[tuple[int, int, str]],
                     added: list[str],
                     ) -> list[tuple[str, list[int]]]:
         """Group a layer's newly-added chunk bytes into pack blobs in
@@ -261,9 +263,10 @@ class ChunkStore:
         added chunk inside a pack (offset = sum of the lengths of the
         pack's preceding members, in index order).
 
-        One streaming pass over the gzip blob, like index_layer: bytes
-        of non-added chunks are skipped, added bytes accumulate into
-        ~pack_target_bytes() buffers, so peak memory is one pack."""
+        Member bytes come from the local CAS — index_layer stored every
+        added chunk moments before — so assembling packs costs no
+        second decompression pass over the layer blob. Peak memory is
+        one ~pack_target_bytes() buffer."""
         added_set = set(added)
         target = pack_target_bytes()
         packs: list[tuple[str, list[int]]] = []
@@ -282,26 +285,19 @@ class ChunkStore:
             buf = bytearray()
             members = []
 
-        with open(layer_blob_path, "rb") as raw:
-            stream = gzip_mod.GzipFile(fileobj=raw, mode="rb")
-            pos = 0
-            for i, (offset, length, hex_digest) in enumerate(chunks):
-                if offset < pos:
-                    raise ValueError(
-                        f"chunk list not offset-sorted at {offset}")
-                _skip(stream, offset - pos)
-                if hex_digest in added_set and hex_digest not in packed:
-                    data = stream.read(length)
-                    if len(data) != length:
-                        raise ValueError("layer stream truncated")
-                    packed.add(hex_digest)
-                    buf += data
-                    members.append(i)
-                    if len(buf) >= target:
-                        flush()
-                else:
-                    _skip(stream, length)
-                pos = offset + length
+        for i, (_, length, hex_digest) in enumerate(chunks):
+            if hex_digest not in added_set or hex_digest in packed:
+                continue
+            data = self.get(hex_digest)
+            if len(data) != length:
+                raise ValueError(
+                    f"chunk {hex_digest} CAS size {len(data)} != "
+                    f"recorded length {length}")
+            packed.add(hex_digest)
+            buf += data
+            members.append(i)
+            if len(buf) >= target:
+                flush()
         flush()
         return packs
 
@@ -410,7 +406,12 @@ class ChunkStore:
                 try:
                     _, length, hex_digest = chunks[i]
                 except (IndexError, TypeError, ValueError):
-                    return missing, False  # malformed mapping
+                    # Malformed mapping: the entry came from a pack
+                    # writer, so its chunks were never pushed as
+                    # individual blobs — report mapped-failure (degrade
+                    # to the blob route), don't unleash the per-chunk
+                    # fallback's guaranteed 404s.
+                    return [], True
                 locate.setdefault(hex_digest, (pack_hex, off, length))
                 off += length
             pack_sizes[pack_hex] = off
@@ -720,11 +721,11 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 layer_hex = pair.gzip_descriptor.digest.hex()
 
                 def push_chunks(added=added, triples=triples,
-                                layer_hex=layer_hex, path=path,
+                                layer_hex=layer_hex,
                                 cache_id=cache_id):
                     if packs_enabled() and added:
                         if _push_as_packs(added, triples, layer_hex,
-                                          path, cache_id):
+                                          cache_id):
                             return
                         log.warning("pack push for %s failed; falling "
                                     "back to per-chunk blobs", cache_id)
@@ -754,7 +755,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                         log.warning("chunk pin for %s failed: %s",
                                     layer_hex, e)
 
-                def _push_as_packs(added, triples, layer_hex, path,
+                def _push_as_packs(added, triples, layer_hex,
                                    cache_id) -> bool:
                     """Wire form: pack blobs (one PUT per ~8MB instead
                     of per ~8KiB chunk), pinned for GC, with the
@@ -762,8 +763,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                     entry so consumers fetch packs, not chunks."""
                     packs = []
                     try:
-                        packs = chunk_store.build_packs(path, triples,
-                                                        added)
+                        packs = chunk_store.build_packs(triples, added)
                         chunk_store.push_packs(packs)
                         chunk_store.pin_packs(layer_hex, packs)
                         manager.set_entry_packs(

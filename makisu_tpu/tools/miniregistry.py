@@ -80,7 +80,10 @@ def _parse_range(header: str | None, size: int) -> tuple[int, int] | None:
         start, end = int(first), int(last) + 1
     except ValueError:
         return None
-    if start < 0 or end <= start:
+    if start < 0 or end <= start or start >= size:
+        # Includes unsatisfiable starts: serving the whole blob (200)
+        # is always a legal answer to a Range request; an empty 206
+        # would not be.
         return None
     return start, min(end, size)
 

@@ -452,7 +452,7 @@ def test_single_member_pack_aliases_its_chunk_safely(tmp_path):
     triples = [(0, len(data), chunk_hex)]
     added = store.index_layer(str(blob_path), triples)
     assert added == [chunk_hex]
-    packs = store.build_packs(str(blob_path), triples, added)
+    packs = store.build_packs(triples, added)
     assert len(packs) == 1 and packs[0][0] == chunk_hex  # the alias
     store.drop_local_packs(packs)
     assert store.cas.exists(chunk_hex)  # producer kept its chunk

@@ -168,3 +168,41 @@ def test_chunk_pin_manifest_accepted_by_real_registry(tmp_path,
     # Accepted (PUT returned 2xx): distributed chunk dedup is live on
     # this registry. (The pin manifest is not pull_manifest-compatible
     # by design — our client rejects non-layer media types on pull.)
+
+
+def test_pack_round_trip_against_real_registry(tmp_path, registry_addr):
+    """Packs are the default wire format for chunks: push a pack, pin it
+    under the makisu-packs tag namespace, then fetch a member span back
+    with an HTTP Range request and carve it. Probes both the custom
+    pack media type (pin acceptance) and Range support (206 vs the
+    documented 200 whole-blob degradation)."""
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.docker.image import Digest
+    from makisu_tpu.utils.httputil import HTTPError
+
+    store = ImageStore(str(tmp_path / "store"))
+    client = RegistryClient(store, registry_addr, "makisu-e2e/packs")
+    chunks = ChunkStore(str(tmp_path / "chunks"))
+    chunks.set_remote(client)
+
+    # A two-member pack, pushed as one blob.
+    member_a, member_b = b"a" * 5000, b"b" * 7000
+    pack = member_a + member_b
+    pack_hex = hashlib.sha256(pack).hexdigest()
+    chunks.cas.write_bytes(pack_hex, pack)
+    chunks.push_remote(pack_hex)
+    try:
+        chunks.pin_packs("e" * 64, [(pack_hex, [0, 1])])
+    except HTTPError as e:
+        pytest.xfail(f"registry rejects pack media type ({e.status}): "
+                     "pack pins degrade, packs still fetchable until GC")
+
+    # Ranged fetch of the second member only.
+    got = chunks.registry.pull_blob_range(
+        Digest.from_hex(pack_hex), len(member_a), len(pack))
+    assert got is not None
+    kind, data = got
+    if kind == "partial":
+        assert data == member_b
+    else:  # Range unsupported: whole blob, caller carves
+        assert data == pack

@@ -199,6 +199,37 @@ def test_manifest_list_requires_sub_manifests(reg):
         "MANIFEST_BLOB_UNKNOWN"
 
 
+def test_blob_range_requests(reg):
+    """Range GETs over a real socket: 206 with exactly the requested
+    slice (the chunk-pack consumer path), 200 for malformed or
+    multi-range specs (serving the whole blob is always legal), and
+    clamping at the blob's end."""
+    data = bytes(range(256)) * 40  # 10240 bytes
+    digest = _digest(data)
+    resp, _ = _req(reg, "POST",
+                   "/v2/r/app/blobs/uploads/?digest=" + digest,
+                   body=data)
+    assert resp.status == 201
+
+    resp, body = _req(reg, "GET", f"/v2/r/app/blobs/{digest}",
+                      headers={"Range": "bytes=100-355"})
+    assert resp.status == 206
+    assert body == data[100:356]
+
+    # Clamped past EOF.
+    resp, body = _req(reg, "GET", f"/v2/r/app/blobs/{digest}",
+                      headers={"Range": "bytes=10200-999999"})
+    assert resp.status == 206
+    assert body == data[10200:]
+
+    # Unsupported shapes degrade to the full blob.
+    for bad in ("bytes=5-2", "bytes=-100", "bytes=0-1,5-9", "chars=1-2"):
+        resp, body = _req(reg, "GET", f"/v2/r/app/blobs/{digest}",
+                          headers={"Range": bad})
+        assert resp.status == 200, bad
+        assert body == data
+
+
 def test_cross_repo_mount(reg):
     blob = b"shared base layer"
     d = _push_blob(reg, "lib/base", blob)
