@@ -50,8 +50,8 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Stage names in child execution order; the parent reports the deepest
 # one whose line it saw. Keep in sync with _child_main.
-_STAGES = ("start", "import", "backend", "tiny", "big", "prod", "ab",
-           "ab_sha")
+_STAGES = ("start", "import", "backend", "tiny", "big", "native",
+           "prod", "ab", "ab_sha")
 
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
@@ -123,6 +123,37 @@ def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
         # keeps the dispatch-rate illusion out of the record.
         return None, compile_s
     return nbytes_per_iter / (delta / iters) / 1e9, compile_s
+
+
+def _native_cpu_gbps(nbytes: int = 96 * 1024 * 1024) -> dict:
+    """End-to-end ChunkSession throughput on the NATIVE CPU route
+    (striped C++ gear recurrence + hashlib SHA-256) — the route
+    production actually takes on a host whose JAX backend is the CPU,
+    so on the CPU fallback this, not the XLA-on-CPU number, is the
+    honest 'snapshot-hash throughput of this host'."""
+    from makisu_tpu.chunker.cdc import ChunkSession, _native_cpu_route
+    if not _native_cpu_route():
+        return {"native_error": "native route unavailable "
+                                "(libgear.so / non-cpu backend)"}
+    payload = np.random.default_rng(4).integers(
+        0, 256, size=nbytes, dtype=np.uint8).tobytes()
+    warm = ChunkSession()
+    warm.update(payload[:4 * 1024 * 1024])
+    warm.finish()
+    t0 = time.perf_counter()
+    s = ChunkSession()
+    # Feed like a tar writer does — piecewise — so staging stays near
+    # one block (a single giant update would measure bytearray
+    # front-deletion, not the chunker).
+    for i in range(0, len(payload), 1 << 20):
+        s.update(payload[i:i + (1 << 20)])
+    chunks = s.finish()
+    dt = time.perf_counter() - t0
+    if not s._native or not chunks:
+        return {"native_error": "native route did not engage"}
+    return {"native_gbps": round(nbytes / dt / 1e9, 3),
+            "native_chunks": len(chunks),
+            "native_route": "cpp-gear-striped+hashlib-sha"}
 
 
 def _measure_hasher(batch: int, block_bytes: int, lanes: int,
@@ -441,6 +472,15 @@ def _child_main() -> int:
         _emit("big", backend=backend, gbps=round(gbps, 3),
               compile_secs=round(compile_s, 1), **big_extra)
 
+    if backend == "cpu":
+        # The production route on a CPU host bypasses XLA entirely
+        # (chunker/cdc.py native route); measure what a build on THIS
+        # host actually gets.
+        try:
+            _emit("native", backend=backend, **_native_cpu_gbps())
+        except Exception as e:  # noqa: BLE001 - informational stage
+            _emit("native", backend=backend,
+                  native_error=str(e)[:300])
     if backend != "cpu":
         # Production shapes: what ONE ChunkSession actually dispatches
         # (a single 4MiB+halo gear stream; a 512-lane 16KiB sha bucket,
@@ -684,8 +724,19 @@ def main() -> int:
 
     # Headline value: the big-shape number if it was measured, else the
     # tiny-shape device number (better a small-shape device datapoint
-    # than nothing — flagged via value_source).
-    if "gbps" in result:
+    # than nothing — flagged via value_source). On the CPU fallback the
+    # production chunker takes the native route (C++ gear + hashlib),
+    # so ITS end-to-end number is this host's honest snapshot-hash
+    # throughput — the XLA-on-CPU figure stays recorded alongside.
+    if result.get("backend") == "cpu" and "native_gbps" in result:
+        # The native number IS this host's production throughput —
+        # headline it even if it regresses below the XLA-on-CPU figure
+        # (a regression production feels must be visible here, not
+        # papered over by a route builds don't take).
+        value, source = result["native_gbps"], "native-cpu"
+        if "gbps" in result:
+            result.setdefault("xla_cpu_gbps", result["gbps"])
+    elif "gbps" in result:
         value, source = result["gbps"], "big"
     elif "tiny_gbps" in result:
         value, source = result["tiny_gbps"], "tiny"
@@ -702,6 +753,8 @@ def main() -> int:
     if source != "big":
         record["value_source"] = source
     for extra in ("tiny_gbps", "tiny_timing_invalid", "big_timing_invalid",
+                  "native_gbps", "native_chunks", "native_route",
+                  "native_error", "xla_cpu_gbps",
                   "init_secs", "compile_secs",
                   "tiny_compile_secs", "gear_xla_gbps", "gear_pallas_gbps",
                   "gear_v2_gbps", "gear_v2_error",

@@ -85,7 +85,20 @@ class ChunkStore:
     of chunk dedup, reusing the same infrastructure as layer blobs.
     """
 
-    def __init__(self, root: str, max_entries: int = 65536) -> None:
+    def __init__(self, root: str, max_entries: int | None = None) -> None:
+        if max_entries is None:
+            # Sized for the north-star scale: a 4GB layer is ~500k
+            # chunks at the 8KiB average, and BOTH halves of dedup
+            # depend on retention — build_packs reads added chunks back
+            # from this CAS, and a warm rebuild's coverage is whatever
+            # survived here. Eviction below the largest layer's chunk
+            # count silently turns dedup off for exactly the layers it
+            # exists for (MAKISU_TPU_CHUNK_CAS_ENTRIES tunes it).
+            try:
+                max_entries = int(os.environ.get(
+                    "MAKISU_TPU_CHUNK_CAS_ENTRIES", str(1 << 20)))
+            except ValueError:
+                max_entries = 1 << 20  # cache sizing never fails builds
         self.cas = CASStore(root, max_entries)
         self.registry = None  # attach via set_remote()
 

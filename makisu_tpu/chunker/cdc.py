@@ -44,6 +44,23 @@ from makisu_tpu.ops import gear, sha256
 
 BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
 
+
+def _native_cpu_route() -> bool:
+    """Whether this process should chunk natively (C++ gear + hashlib)
+    instead of driving the JAX backend: only when that backend IS the
+    CPU — same math, ~10x less overhead — never on a real accelerator.
+    MAKISU_TPU_CHUNK_NATIVE=0 forces the XLA route (A/B, debugging)."""
+    import os
+    if os.environ.get("MAKISU_TPU_CHUNK_NATIVE", "1") != "1":
+        return False
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+    except Exception:  # noqa: BLE001 - backend init failure
+        return False
+    from makisu_tpu import native
+    return native.gear_scan_available()
+
 # Lane-buffer buckets: (capacity, lanes). Chunk avg is 8 KiB and max
 # 64 KiB, so most chunks hash in the 16 KiB bucket; each bucket is one
 # compiled XLA program reused forever.
@@ -150,6 +167,17 @@ class ChunkSession:
         err = _backend.backend_ready()
         if err is not None:
             self._degrade("backend init", RuntimeError(err))
+        # CPU hosts (build boxes with no accelerator) take the native
+        # route: a striped C++ gear recurrence + hashlib digests,
+        # bit-identical to the device formulation and ~10x driving
+        # XLA's CPU backend through the vector form. The service path
+        # (cross-build device batching) and non-cpu backends keep the
+        # device route.
+        self._native = (self._degraded is None and service is None
+                        and _native_cpu_route())
+        # The gear table is deterministic by contract; one copy per
+        # session, not one 256-iteration rebuild per 4MiB block.
+        self._table = gear.gear_table() if self._native else None
 
     # -- failure discipline ----------------------------------------------
 
@@ -249,7 +277,19 @@ class ChunkSession:
         halo = self._halo
         buf = np.frombuffer(halo + blk, dtype=np.uint8)
         entry = None
-        if gear_pallas.v2_enabled():
+        if self._native:
+            # Synchronous by design: the scan is faster than a device
+            # round trip, so there is nothing to overlap. The C++ scan
+            # returns candidate POSITIONS directly — no bit array, no
+            # host-side nonzero rescan.
+            from makisu_tpu import native
+            pos = native.gear_scan_positions(
+                buf, self._table, (1 << self.avg_bits) - 1)
+            lo = np.searchsorted(pos, len(halo))
+            hi = np.searchsorted(pos, len(halo) + live)
+            entry = ("native", pos[lo:hi] - len(halo), None,
+                     live, blk, self._scanned)
+        if entry is None and gear_pallas.v2_enabled():
             # Opt-in natural-layout kernel (MAKISU_TPU_PALLAS_V2=1):
             # pure-reshape staging, full-buffer bitmap (XLA-contract
             # slicing) — see gear_pallas.py v2 block.
@@ -298,18 +338,24 @@ class ChunkSession:
     def _process_block(self, entry: tuple) -> None:
         """Read back one block's bitmap (bounded sync) and cut chunks."""
         kind, words, meta, live, blk, base = entry
-        host_words = _backend.sync_bounded(words, "gear bitmap readback")
-        if kind == "pallas":
+        if kind == "native":
+            candidates = words.astype(np.int64) + base  # host positions
+        elif kind == "pallas":
             from makisu_tpu.ops import gear_pallas
+            host_words = _backend.sync_bounded(
+                words, "gear bitmap readback")
             nrows = meta
             bits = gear.unpack_bits_np(
                 host_words[:nrows], nrows * gear_pallas.ROW)
-            bits = bits.reshape(-1)[:live]
+            candidates = np.nonzero(
+                bits.reshape(-1)[:live])[0] + base
         else:
+            host_words = _backend.sync_bounded(
+                words, "gear bitmap readback")
             halo_len = meta
             bits = gear.unpack_bits_np(
                 host_words, halo_len + live)[halo_len:halo_len + live]
-        candidates = np.nonzero(bits)[0] + base
+            candidates = np.nonzero(bits)[0] + base
         self._tail.extend(blk[:live])
         for pos in candidates:
             self._cut_to(int(pos) + 1)  # cut AFTER the boundary byte
@@ -339,6 +385,13 @@ class ChunkSession:
         self._prev_cut = end
 
     def _emit(self, data: bytes, offset: int) -> None:
+        if self._native:
+            # hashlib IS the native SHA-256 (OpenSSL, SHA-NI): no lane
+            # batching to amortize on a CPU host.
+            import hashlib
+            self._chunks.append(
+                Chunk(offset, len(data), hashlib.sha256(data).digest()))
+            return
         if self.service is not None:
             self._service_pending.append(
                 (offset, len(data),

@@ -27,6 +27,7 @@ _NATIVE_DIR = os.environ.get("MAKISU_TPU_NATIVE_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpgzip.so")
 _LSK_PATH = os.path.join(_NATIVE_DIR, "liblayersink.so")
+_GEAR_PATH = os.path.join(_NATIVE_DIR, "libgear.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -128,6 +129,95 @@ def _load_lsk() -> ctypes.CDLL | None:
 
 def layersink_available() -> bool:
     return _load_lsk() is not None
+
+
+_gear_lib: ctypes.CDLL | None = None
+_gear_failed = False
+
+
+def _load_gear() -> ctypes.CDLL | None:
+    global _gear_lib, _gear_failed
+    with _lock:
+        if _gear_lib is not None or _gear_failed:
+            return _gear_lib
+        if not _ensure_built(_GEAR_PATH):
+            _gear_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_GEAR_PATH)
+            lib.gear_scan.restype = None
+            lib.gear_scan.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint8)]
+            lib.gear_scan_pos.restype = ctypes.c_int
+            lib.gear_scan_pos.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32)]
+            _gear_lib = lib
+        except (OSError, AttributeError):
+            _gear_failed = True
+        return _gear_lib
+
+
+def gear_scan_available() -> bool:
+    return _load_gear() is not None
+
+
+def gear_scan_bits(buf, table, mask: int):
+    """Boundary-candidate bits for ``buf`` (np.uint8 array) — the CPU
+    recurrence form of ops.gear's windowed scan, bit-identical. ``table``
+    is gear.gear_table() (np.uint32[256]); returns np.uint8[len(buf)]
+    with 1 where (h & mask) == 0."""
+    import numpy as np
+
+    lib = _load_gear()
+    if lib is None:
+        raise OSError("libgear.so unavailable")
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    table = np.ascontiguousarray(table, dtype=np.uint32)
+    out = np.empty(len(buf), dtype=np.uint8)
+    lib.gear_scan(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+        table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_uint32(mask),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def gear_scan_positions(buf, table, mask: int):
+    """Boundary-candidate POSITIONS for ``buf`` — same predicate as
+    gear_scan_bits with no bit-array materialization or host rescan.
+    Returns a sorted np.uint32 array. Capacity is 4x the expected hit
+    rate; the (adversarial-data) overflow case falls back to the bit
+    scan, so the result is always complete."""
+    import numpy as np
+
+    lib = _load_gear()
+    if lib is None:
+        raise OSError("libgear.so unavailable")
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    table = np.ascontiguousarray(table, dtype=np.uint32)
+    n = len(buf)
+    expected = n // max(mask, 1) + 1
+    stripe_cap = max(64, expected)  # 4 stripes x ~4x margin overall
+    out = np.empty(4 * stripe_cap, dtype=np.uint32)
+    counts = np.zeros(4, dtype=np.uint32)
+    rc = lib.gear_scan_pos(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_uint32(mask),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        stripe_cap,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    if rc != 0:
+        bits = gear_scan_bits(buf, table, mask)
+        return np.nonzero(bits)[0].astype(np.uint32)
+    return np.concatenate([
+        out[s * stripe_cap:s * stripe_cap + int(counts[s])]
+        for s in range(4)])
 
 
 class LayerSinkHandle:

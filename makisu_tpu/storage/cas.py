@@ -151,8 +151,23 @@ class CASStore:
     # -- eviction ---------------------------------------------------------
 
     def _evict_locked(self) -> None:
-        while len(self._last_access) > self.max_entries:
-            victim = min(self._last_access, key=self._last_access.get)
+        """Evict the LRU overflow. Large stores (the ~1M-entry chunk
+        CAS) evict in 10% batches: a min() scan per insert is O(n), and
+        at a few hundred thousand entries the store would spend its
+        time scanning access times, not storing bytes. Small stores
+        (the 256-entry layer-blob cache, where every entry is a warm
+        multi-hundred-MB blob) evict exactly the excess — dumping 10%
+        of THOSE would force re-pulls the old one-at-a-time policy
+        never did."""
+        import heapq
+        if len(self._last_access) <= self.max_entries:
+            return
+        excess = len(self._last_access) - self.max_entries
+        batch = excess if self.max_entries < 4096 else max(
+            excess, self.max_entries // 10)
+        victims = heapq.nsmallest(batch, self._last_access,
+                                  key=self._last_access.get)
+        for victim in victims:
             p = self._path(victim)
             if os.path.isfile(p):
                 os.unlink(p)

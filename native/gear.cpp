@@ -1,0 +1,137 @@
+// Gear CDC boundary scan, CPU-native.
+//
+// The accelerator formulation (makisu_tpu/ops/gear.py) computes
+//   h_i = sum_{m=0}^{31} G[b_{i-m}] << m   (mod 2^32)
+// as five doubling steps over whole vectors — the right shape for the
+// VPU. On a CPU host the same function is one scalar recurrence
+//   h = (h << 1) + G[b]                    (mod 2^32)
+// (terms older than 32 bytes leave via the shift). The recurrence is a
+// loop-carried dependency (~5 cycles/byte), so the scan runs STRIPED:
+// the window is exactly 32 bytes — h_i depends on bytes i-31..i and
+// nothing older — so any position can be recomputed from a 32-byte
+// warmup. Four interleaved stripes give the core four independent
+// dependency chains (~4x IPC) on one thread; results are bit-identical
+// to the sequential recurrence and to the accelerator formulation
+// (pinned by tests/test_chunker_native.py).
+//
+// The table is passed in from Python (gear.gear_table()) so there is
+// exactly one site that defines the boundary function's constants.
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+constexpr size_t kWindow = 32;   // bytes of history in a 32-bit h
+constexpr size_t kStripes = 4;
+
+inline void scan_range(const uint8_t *data, size_t begin, size_t end,
+                       const uint32_t *table, uint32_t mask,
+                       uint8_t *out) {
+  // Emit out[i] for i in [begin, end); warm h up over the (up to) 32
+  // bytes before begin so the stripe seam is invisible.
+  uint32_t h = 0;
+  size_t warm = begin >= kWindow ? begin - kWindow : 0;
+  for (size_t i = warm; i < begin; ++i) h = (h << 1) + table[data[i]];
+  for (size_t i = begin; i < end; ++i) {
+    h = (h << 1) + table[data[i]];
+    out[i] = (h & mask) == 0 ? 1 : 0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Candidate POSITIONS (not bits): one pass, no bit-array write + host
+// rescan. Positions are emitted striped — stripe s appends into
+// out_pos[s*stripe_cap ..] and counts[s] says how many — and the
+// caller concatenates (stripes cover ascending disjoint ranges, so the
+// result is sorted). Returns 0 on success, 1 when any stripe overflows
+// its slot capacity (adversarial data denser than the mask's expected
+// rate) — the caller falls back to the bit scan.
+int gear_scan_pos(const uint8_t *data, size_t n, const uint32_t *table,
+                  uint32_t mask, uint32_t *out_pos, size_t stripe_cap,
+                  uint32_t *counts) {
+  size_t bounds[kStripes + 1];
+  for (size_t s = 0; s <= kStripes; ++s) bounds[s] = n * s / kStripes;
+  uint32_t h[kStripes];
+  size_t cnt[kStripes];
+  for (size_t s = 0; s < kStripes; ++s) {
+    h[s] = 0;
+    cnt[s] = 0;
+    size_t begin = bounds[s];
+    size_t warm = begin >= kWindow ? begin - kWindow : 0;
+    for (size_t i = warm; i < begin; ++i)
+      h[s] = (h[s] << 1) + table[data[i]];
+  }
+  size_t len = n;  // shortest stripe
+  for (size_t s = 0; s < kStripes; ++s)
+    if (bounds[s + 1] - bounds[s] < len) len = bounds[s + 1] - bounds[s];
+  // Interleaved: four independent dependency chains in one loop body.
+  // The hit branch is ~1-in-2^avg_bits, so it predicts perfectly.
+  for (size_t k = 0; k < len; ++k) {
+    for (size_t s = 0; s < kStripes; ++s) {
+      size_t i = bounds[s] + k;
+      h[s] = (h[s] << 1) + table[data[i]];
+      if ((h[s] & mask) == 0) {
+        if (cnt[s] == stripe_cap) return 1;
+        out_pos[s * stripe_cap + cnt[s]++] = static_cast<uint32_t>(i);
+      }
+    }
+  }
+  // Stripe tails (uneven division): finish sequentially per stripe.
+  for (size_t s = 0; s < kStripes; ++s) {
+    for (size_t i = bounds[s] + len; i < bounds[s + 1]; ++i) {
+      h[s] = (h[s] << 1) + table[data[i]];
+      if ((h[s] & mask) == 0) {
+        if (cnt[s] == stripe_cap) return 1;
+        out_pos[s * stripe_cap + cnt[s]++] = static_cast<uint32_t>(i);
+      }
+    }
+    counts[s] = static_cast<uint32_t>(cnt[s]);
+  }
+  return 0;
+}
+
+// out[i] = 1 iff position i is a boundary candidate ((h_i & mask) == 0).
+// The caller hands the same halo-prefixed buffer the device path scans
+// and slices off the halo positions itself.
+void gear_scan(const uint8_t *data, size_t n, const uint32_t *table,
+               uint32_t mask, uint8_t *out) {
+  if (n < kStripes * 4 * kWindow) {
+    scan_range(data, 0, n, table, mask, out);
+    return;
+  }
+  // Four stripes, interleaved in one loop: independent chains the core
+  // can overlap. Stripe s covers [bounds[s], bounds[s+1]).
+  size_t bounds[kStripes + 1];
+  for (size_t s = 0; s <= kStripes; ++s) bounds[s] = n * s / kStripes;
+  uint32_t h[kStripes];
+  size_t pos[kStripes];
+  for (size_t s = 0; s < kStripes; ++s) {
+    h[s] = 0;
+    pos[s] = bounds[s];
+    size_t warm = pos[s] >= kWindow ? pos[s] - kWindow : 0;
+    for (size_t i = warm; i < pos[s]; ++i)
+      h[s] = (h[s] << 1) + table[data[i]];
+  }
+  size_t len = bounds[1] - bounds[0];  // shortest stripe bounds later
+  for (size_t s = 0; s < kStripes; ++s)
+    if (bounds[s + 1] - bounds[s] < len) len = bounds[s + 1] - bounds[s];
+  for (size_t k = 0; k < len; ++k) {
+    for (size_t s = 0; s < kStripes; ++s) {
+      size_t i = bounds[s] + k;
+      h[s] = (h[s] << 1) + table[data[i]];
+      out[i] = (h[s] & mask) == 0 ? 1 : 0;
+    }
+  }
+  // Stripe tails (uneven division): finish sequentially per stripe.
+  for (size_t s = 0; s < kStripes; ++s) {
+    size_t done = bounds[s] + len;
+    if (done < bounds[s + 1])
+      scan_range(data, done, bounds[s + 1], table, mask, out);
+  }
+}
+
+}  // extern "C"

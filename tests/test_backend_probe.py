@@ -280,6 +280,9 @@ def test_sync_bounded_propagates_errors():
 
 
 def test_chunk_session_degrades_on_readback_hang(monkeypatch):
+    # Device-failure simulation: pin the XLA route (the native
+    # CPU route never touches the device and cannot fail this way).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.chunker import cdc
 
     monkeypatch.delenv("MAKISU_TPU_CHUNK_STRICT", raising=False)

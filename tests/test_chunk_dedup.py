@@ -805,6 +805,9 @@ def test_device_failure_degrades_chunking_not_build(tmp_path, monkeypatch):
     MAKISU_TPU_CHUNK_STRICT=1 (the test suite's default) the same
     failure raises instead. The payload exceeds the 4MiB dispatch block
     so the failure fires from update(), the advertised mid-stream case."""
+    # Device-failure simulation: pin the XLA route (the native
+    # CPU route never touches the device and cannot fail this way).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.chunker.cdc import BLOCK
     from makisu_tpu.ops import gear
 
@@ -829,6 +832,9 @@ def test_device_failure_degrades_chunking_not_build(tmp_path, monkeypatch):
 def test_device_failure_in_lane_hashing_degrades(tmp_path, monkeypatch):
     """Same discipline when the GEAR scan works but the SHA-256 lane
     hashing dies (the 'lane hashing' drain stage)."""
+    # Device-failure simulation: pin the XLA route (the native
+    # CPU route never touches the device and cannot fail this way).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.ops import sha256 as sha_mod
 
     def boom(*a, **k):
@@ -845,6 +851,9 @@ def test_device_failure_in_lane_hashing_degrades(tmp_path, monkeypatch):
 def test_degraded_session_ignores_further_updates(monkeypatch):
     """After degrading, update() is a no-op (no re-dispatch, no staging
     growth) and finish() returns []."""
+    # Device-failure simulation: pin the XLA route (the native
+    # CPU route never touches the device and cannot fail this way).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.chunker.cdc import ChunkSession
     from makisu_tpu.ops import gear
 

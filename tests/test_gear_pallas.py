@@ -88,6 +88,9 @@ def test_gear_bitmap_flat_matches_staged_rows(start, live):
 def test_chunk_session_falls_back_to_xla_on_kernel_failure(monkeypatch):
     """A Pallas failure must downgrade to the XLA gear path (identical
     chunks), not degrade fingerprinting."""
+    # Kernel-route test: pin off the native CPU route (it never
+    # touches Pallas, so the simulated failure would not fire).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.chunker.cdc import ChunkSession
 
     payload = np.random.default_rng(11).integers(
@@ -157,6 +160,9 @@ def test_v2_failure_falls_back_to_v1_not_xla(monkeypatch):
     """A v2-kernel failure must trip ONLY v2's breaker (advisor r3):
     the production-default v1 route — with its measured device win —
     keeps running; chunks are identical either way."""
+    # Kernel-route test: pin off the native CPU route (it never
+    # touches Pallas, so the simulated failure would not fire).
+    monkeypatch.setenv("MAKISU_TPU_CHUNK_NATIVE", "0")
     from makisu_tpu.chunker.cdc import ChunkSession
 
     payload = np.random.default_rng(13).integers(
