@@ -768,6 +768,24 @@ def main() -> int:
                   "jax_platforms_env", "device_kind"):
         if extra in result:
             record[extra] = result[extra]
+    # The OTHER BASELINE.md target (>=3x warm-cache at 100k files) is
+    # measured by benchmarks/northstar.py at full scale (~30 min, real
+    # TCP registry) and committed as artifacts; surface the committed
+    # numbers here so the driver's record carries both targets.
+    for name, key in (("northstar_full_25mbps.json", "northstar_25mbps"),
+                      ("northstar_full.json", "northstar_100mbps")):
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "benchmarks", name),
+                    encoding="utf-8") as f:
+                ns = json.loads(f.read())
+            record[key] = {
+                k: ns[k] for k in
+                ("files", "mb", "speedup_vs_layer", "speedup_vs_cold",
+                 "warm_chunk_seconds", "warm_layer_seconds",
+                 "cold_seconds") if k in ns}
+        except (OSError, ValueError, KeyError):
+            pass
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))
