@@ -778,13 +778,15 @@ def main() -> int:
             with open(os.path.join(os.path.dirname(
                     os.path.abspath(__file__)), "benchmarks", name),
                     encoding="utf-8") as f:
-                ns = json.loads(f.read())
+                ns = json.load(f)
+            if not isinstance(ns, dict):
+                continue
             record[key] = {
                 k: ns[k] for k in
                 ("files", "mb", "speedup_vs_layer", "speedup_vs_cold",
                  "warm_chunk_seconds", "warm_layer_seconds",
                  "cold_seconds") if k in ns}
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, TypeError):
             pass
     if errors:
         record["error"] = "; ".join(errors)

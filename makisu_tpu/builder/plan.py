@@ -87,6 +87,15 @@ class BuildPlan:
                 f"target stage not found in dockerfile: {self.stage_target}")
 
     def execute(self) -> DistributionManifest:
+        try:
+            return self._execute()
+        finally:
+            # Persist the stat-keyed content-ID cache even on failure:
+            # whatever hashing this build DID pay, the next warm build
+            # should inherit (the write is atomic and advisory).
+            self.base_ctx.content_ids.save()
+
+    def _execute(self) -> DistributionManifest:
         curr = None
         for k, stage in enumerate(self.stages):
             curr = stage

@@ -58,6 +58,14 @@ class BuildContext:
         # cached for the build.
         self._ignore_excluded: list[str] | None = None
         self._ignore_prefixes = None  # PrefixSet over _ignore_excluded
+        # Stat-keyed content-ID cache (utils/statcache.py): warm builds
+        # skip re-reading context files whose (size, mtime, ctime,
+        # inode) is unchanged. Lives in the storage dir beside the KV
+        # cache; BuildPlan.execute saves it.
+        from makisu_tpu.utils.statcache import ContentIDCache
+        self.content_ids = ContentIDCache(
+            os.path.join(image_store.root, "content_id_cache.json"),
+            namespace=os.path.abspath(context_dir))
 
     def context_excluded_paths(self) -> list[str]:
         """Absolute context paths excluded by .dockerignore (empty when
@@ -107,4 +115,7 @@ class BuildContext:
                           sync_wait=self.memfs.sync_wait)
         ctx._ignore_excluded = self._ignore_excluded
         ctx._ignore_prefixes = self._ignore_prefixes
+        # SHARED, not fresh: stages hash the same context files, and
+        # the plan saves the base context's cache once at the end.
+        ctx.content_ids = self.content_ids
         return ctx

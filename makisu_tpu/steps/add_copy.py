@@ -130,12 +130,23 @@ class AddCopyStep(BuildStep):
                 checksum = self._checksum_tree(
                     ctx, os.path.join(path, name), checksum)
             return checksum
-        with open(path, "rb") as f:
-            while True:
-                chunk = f.read(1 << 20)
-                if not chunk:
-                    return checksum
-                checksum = zlib.crc32(chunk, checksum)
+        # Per-file content summary, framed into the rolling checksum.
+        # The summary (not the raw byte stream) is what chains, so a
+        # file's crc can come from the stat-keyed cache
+        # (utils/statcache.py) and a warm rebuild re-reads only files
+        # whose stat changed — identical cache IDs either way.
+        file_crc = ctx.content_ids.get(rel, st)
+        if file_crc is None:
+            file_crc = 0
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    file_crc = zlib.crc32(chunk, file_crc)
+            ctx.content_ids.put(rel, st, file_crc)
+        frame = f"{st.st_size}:{file_crc & 0xFFFFFFFF:08x};".encode()
+        return zlib.crc32(frame, checksum)
 
     def _stage_inline_files(self, ctx: BuildContext) -> str:
         """Write heredoc bodies as real files in the build sandbox (they

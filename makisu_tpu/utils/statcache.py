@@ -1,0 +1,156 @@
+"""Stat-keyed content-ID cache: skip re-hashing unchanged context files.
+
+COPY/ADD cache identity covers the bytes being copied, so every build
+hashes its context — at the north-star scale that is 100k files / 4GB
+re-read on every warm rebuild whose content didn't change. This cache
+remembers each file's content crc32 keyed by the stat quadruple
+``(size, mtime_ns, ctime_ns, inode)``; a warm build re-hashes only
+files whose stat changed. The keying is the git index discipline:
+mtime+size alone can be spoofed by an editor that restores timestamps,
+but a content write always bumps ctime (utime cannot restore it), so a
+stale hit requires deliberately lying to the filesystem, not normal
+tooling. ``MAKISU_TPU_STAT_CACHE=0`` disables the shortcut (every file
+re-reads); either way the cache ID format is identical, so toggling
+the switch never invalidates caches.
+
+The reference re-hashes the full context every build
+(lib/builder/step/add_copy_step.go SetCacheID); this is the buildkit
+-style refinement of the same identity.
+
+Racy-stat discipline (git's "racily clean" rule): a same-size edit in
+the same timestamp tick as the hash would alias the stat key on
+filesystems with coarse timestamps, so an entry is only TRUSTED when
+the file's timestamps predate the recorded hash time by more than the
+coarsest plausible granularity (2s, covering 1s filesystems). Files
+touched within that window of being hashed simply re-hash next build —
+a bounded perf cost, never a stale identity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+VERSION = 2
+# A cached entry is trusted only if the file's mtime/ctime are at least
+# this much older than the moment it was hashed (coarsest common fs
+# timestamp granularity, with margin).
+RACY_WINDOW_NS = 2_000_000_000
+# Entries not touched by the saving build are kept up to this many
+# (other contexts share a storage dir); beyond it, untouched entries
+# age out oldest-file-first is overkill — drop arbitrarily.
+MAX_CARRIED_ENTRIES = 1_000_000
+
+
+def enabled() -> bool:
+    return os.environ.get("MAKISU_TPU_STAT_CACHE", "1") == "1"
+
+
+def racy_window_ns() -> int:
+    """MAKISU_TPU_STAT_CACHE_WINDOW_NS overrides the racily-clean
+    window (tests; operators on known-fine-grained filesystems)."""
+    try:
+        return int(os.environ.get("MAKISU_TPU_STAT_CACHE_WINDOW_NS",
+                                  str(RACY_WINDOW_NS)))
+    except ValueError:
+        return RACY_WINDOW_NS
+
+
+class ContentIDCache:
+    """Per-storage-dir persistent map: rel path -> (stat key, crc32)."""
+
+    def __init__(self, path: str, namespace: str = "") -> None:
+        self.path = path
+        # Entries are scoped by the build context dir (git scopes its
+        # index per worktree the same way): different contexts sharing
+        # one storage dir have colliding rel paths.
+        self._ns = namespace + "\x00"
+        self._lock = threading.Lock()
+        self._entries: dict[str, list] | None = None  # lazy load
+        self._touched: set[str] = set()
+        self._dirty = False
+
+    def _load_locked(self) -> dict[str, list]:
+        if self._entries is None:
+            self._entries = {}
+            try:
+                with open(self.path, encoding="utf-8") as f:
+                    rec = json.load(f)
+                # Shape-validate everything: the file is shared state a
+                # foreign tool or partial write can mangle, and an
+                # advisory cache must start empty on ANY mismatch, not
+                # crash every later build.
+                if (isinstance(rec, dict)
+                        and rec.get("version") == VERSION):
+                    entries = rec.get("entries", {})
+                    if isinstance(entries, dict):
+                        self._entries = {
+                            k: v for k, v in entries.items()
+                            if isinstance(k, str)
+                            and isinstance(v, list) and len(v) == 3
+                            and isinstance(v[0], list)}
+            except (OSError, ValueError):
+                pass  # cache is advisory; start empty
+        return self._entries
+
+    @staticmethod
+    def _key(st: os.stat_result) -> list:
+        # st_dev: rel paths repeat across bind mounts / filesystems
+        # where inode numbers restart; two contexts sharing a storage
+        # dir must never alias.
+        return [st.st_size, st.st_mtime_ns, st.st_ctime_ns, st.st_ino,
+                st.st_dev]
+
+    def get(self, rel: str, st: os.stat_result) -> int | None:
+        if not enabled():
+            return None
+        with self._lock:
+            entry = self._load_locked().get(self._ns + rel)
+            if entry is None or entry[0] != self._key(st):
+                return None
+            # Racily-clean guard: if the file was modified in the same
+            # coarse-timestamp tick it was hashed in, the stat key
+            # cannot distinguish a later same-size edit — re-hash.
+            hashed_at = int(entry[2])
+            newest = max(st.st_mtime_ns, st.st_ctime_ns)
+            if hashed_at - newest < racy_window_ns():
+                return None
+            self._touched.add(self._ns + rel)
+            return int(entry[1])
+
+    def put(self, rel: str, st: os.stat_result, crc: int) -> None:
+        with self._lock:
+            self._load_locked()[self._ns + rel] = [
+                self._key(st), int(crc), time.time_ns()]
+            self._touched.add(self._ns + rel)
+            self._dirty = True
+
+    def save(self) -> None:
+        """Atomic write-back (advisory: failures are swallowed — a cache
+        that can't persist costs re-hashing, never correctness)."""
+        with self._lock:
+            if not self._dirty or self._entries is None:
+                return
+            entries = self._entries
+            if len(entries) > MAX_CARRIED_ENTRIES:
+                entries = {rel: v for rel, v in entries.items()
+                           if rel in self._touched}
+            # PID alone under-keys the temp name: concurrent builds in
+            # one worker PROCESS (a supported mode) would truncate each
+            # other's in-flight write and install corrupt JSON.
+            tmp = (f"{self.path}.{os.getpid()}."
+                   f"{threading.get_ident()}.tmp")
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(json.dumps(
+                        {"version": VERSION, "entries": entries},
+                        separators=(",", ":")))
+                os.replace(tmp, self.path)
+                self._dirty = False
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
