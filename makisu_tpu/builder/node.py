@@ -14,6 +14,7 @@ from makisu_tpu.context import BuildContext
 from makisu_tpu.docker.image import DigestPair, ImageConfig
 from makisu_tpu.steps import BuildStep
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 
 @dataclasses.dataclass
@@ -84,16 +85,20 @@ class BuildNode:
         # through the cache manager when it can supply one — with chunk
         # dedup attached, a lazily-pulled layer streams straight from
         # local chunks (no blob transfer, no gzip inflate at all).
-        open_tar = getattr(cache_mgr, "open_layer_tar", None)
-        if open_tar is not None:
-            with open_tar(pair) as gz:
-                with tarfile.open(fileobj=gz, mode="r|") as tf:
-                    self.ctx.memfs.update_from_tar(tf, untar=modify_fs)
-            return
-        with self.ctx.image_store.layers.open(hex_digest) as f:
-            with tario.gzip_reader(f) as gz:
-                with tarfile.open(fileobj=gz, mode="r|") as tf:
-                    self.ctx.memfs.update_from_tar(tf, untar=modify_fs)
+        with metrics.span("apply_layer", digest=hex_digest[:12]):
+            open_tar = getattr(cache_mgr, "open_layer_tar", None)
+            if open_tar is not None:
+                with open_tar(pair) as gz:
+                    with tarfile.open(fileobj=gz, mode="r|") as tf:
+                        self.ctx.memfs.update_from_tar(tf, untar=modify_fs)
+            else:
+                with self.ctx.image_store.layers.open(hex_digest) as f:
+                    with tario.gzip_reader(f) as gz:
+                        with tarfile.open(fileobj=gz, mode="r|") as tf:
+                            self.ctx.memfs.update_from_tar(
+                                tf, untar=modify_fs)
+        # After the span: a failed application must not count.
+        metrics.counter_add("makisu_cached_layers_applied_total")
 
     def pull_cache_layer(self, cache_mgr) -> bool:
         """Try to prefetch this node's layer. A miss or failure returns

@@ -16,6 +16,7 @@ from makisu_tpu.builder.stage import BuildStage
 from makisu_tpu.context import BuildContext
 from makisu_tpu.docker.image import DistributionManifest, ImageName
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 
 class BuildPlan:
@@ -100,14 +101,18 @@ class BuildPlan:
         for k, stage in enumerate(self.stages):
             curr = stage
             log.info("stage %d/%d: %s", k + 1, len(self.stages), stage)
-            stage.pull_cache_layers(self.cache_mgr)
-            last_stage = k == len(self.stages) - 1
-            copied_from = stage.alias in self.copy_from_dirs
-            stage.last_image_config = None
-            stage.build(self.cache_mgr, last_stage, copied_from)
-            if self.allow_modify_fs:
-                stage.checkpoint(self.copy_from_dirs.get(stage.alias, []))
-                stage.cleanup()
+            with metrics.span("stage", alias=stage.alias, index=k):
+                metrics.counter_add("makisu_stages_total")
+                with metrics.span("pull_cache_layers"):
+                    stage.pull_cache_layers(self.cache_mgr)
+                last_stage = k == len(self.stages) - 1
+                copied_from = stage.alias in self.copy_from_dirs
+                stage.last_image_config = None
+                stage.build(self.cache_mgr, last_stage, copied_from)
+                if self.allow_modify_fs:
+                    stage.checkpoint(
+                        self.copy_from_dirs.get(stage.alias, []))
+                    stage.cleanup()
             # ARG/ENV exports live in each stage context's exec_env
             # (reset per stage), so no process-env restore is needed
             # (reference restores os.environ, :197-204 — we never touch
@@ -115,7 +120,8 @@ class BuildPlan:
             if self.stage_target and stage.alias == self.stage_target:
                 log.info("finished building target stage")
                 break
-        self.cache_mgr.wait_for_push()
+        with metrics.span("wait_for_push"):
+            self.cache_mgr.wait_for_push()
         assert curr is not None
         manifest = curr.save_manifest(self.target)
         for replica in self.replicas:

@@ -25,6 +25,7 @@ from makisu_tpu.docker.image import (
 )
 from makisu_tpu.steps import FromStep, new_step
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 
 @dataclasses.dataclass
@@ -125,7 +126,10 @@ class BuildStage:
             log.info("step %d/%d (%s): %s", i + 1, len(self.nodes), opts,
                      node)
             start = time.time()
-            config = node.build(cache_mgr, config, opts)
+            with metrics.span("step", directive=node.step.directive,
+                              index=i, cached=node.digest_pairs is not None,
+                              skip=opts.skip_build):
+                config = node.build(cache_mgr, config, opts)
             log.info("step %d done", i + 1, duration=time.time() - start)
             if node.digest_pairs:
                 for pair in node.digest_pairs:

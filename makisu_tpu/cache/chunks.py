@@ -26,6 +26,7 @@ from makisu_tpu import tario
 from makisu_tpu.docker.image import Digest, DigestPair
 from makisu_tpu.storage.cas import CASStore
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 # Chunk blobs carry their own media type in pin manifests (raw
 # uncompressed tar-stream slices, not gzip layers).
@@ -384,6 +385,8 @@ class ChunkStore:
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(8) as pool:
             ok = list(pool.map(self._fetch_remote, missing))
+        metrics.counter_add("makisu_chunks_fetched_total", sum(ok),
+                            route="blob")
         return all(ok)
 
     # Coalesce needed spans within a pack when the gap between them is
@@ -531,7 +534,14 @@ class ChunkStore:
                         self.cas.delete(pack_hex)
                     except OSError:
                         pass
+        # Count requests even when every fetch failed — undercounting
+        # during failure episodes is exactly when the metric matters.
+        if n_requests:
+            metrics.counter_add("makisu_chunk_fetch_requests_total",
+                                n_requests)
         if got:
+            metrics.counter_add("makisu_chunks_fetched_total", len(got),
+                                route="pack")
             log.info("fetched %d/%d missing chunks from %d pack(s) in "
                      "%d request(s)", len(got), len(missing),
                      len(by_pack), n_requests)
@@ -761,6 +771,8 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 triples = [(c.offset, c.length, c.hex_digest)
                            for c in commit.chunks]
                 added = chunk_store.index_layer(path, triples)
+                metrics.counter_add("makisu_chunks_indexed_total",
+                                    len(added))
                 log.info("indexed %d new chunks for %s", len(added),
                          cache_id)
             except FileNotFoundError:
@@ -858,9 +870,11 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
             decode_entry_full
         raw = manager._get_raw(cache_id)
         if raw is None:
+            metrics.counter_add("makisu_cache_pull_total", result="miss")
             raise CacheMiss(cache_id)
         pair, chunks, gz_backend, packs = decode_entry_full(raw)
         if pair is None:
+            metrics.counter_add("makisu_cache_pull_total", result="empty")
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not manager.store.layers.exists(hex_digest) and chunks:
@@ -872,6 +886,9 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                     [tuple(c) for c in chunks], packs):
                 with manager._lock:
                     manager._lazy[hex_digest] = raw
+                metrics.counter_add("makisu_cache_pull_total",
+                                    result="hit")
+                metrics.counter_add("makisu_cache_chunk_route_hits_total")
                 log.info("cache hit %s -> %s (lazy: %d chunks "
                          "available)", cache_id, hex_digest, len(chunks))
                 if not manager.lazy_enabled():

@@ -23,9 +23,15 @@ from makisu_tpu.docker.image import (
     DigestPair,
 )
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 EMPTY_ENTRY = "MAKISU_TPU_CACHE_EMPTY"  # a step that committed no layer
 _KV_RETRIES = 3
+
+# Aggregate in-flight async cache pushes across every CacheManager in
+# the process — backs the label-less global push-queue-depth gauge.
+_push_gauge_lock = threading.Lock()
+_push_inflight_total = 0
 
 
 class CacheMiss(KeyError):
@@ -118,6 +124,8 @@ class CacheManager:
                     raw = self.kv.get(cache_id)
                     break
                 except Exception as e:  # noqa: BLE001 - network store
+                    metrics.counter_add("makisu_cache_kv_retries_total",
+                                        op="get")
                     log.warning("cache KV get %s failed (try %d): %s",
                                 cache_id, attempt + 1, e)
             else:
@@ -132,16 +140,20 @@ class CacheManager:
         bytes go through open_layer_tar()/materialize()."""
         raw = self._get_raw(cache_id)
         if raw is None:
+            metrics.counter_add("makisu_cache_pull_total", result="miss")
             raise CacheMiss(cache_id)
         pair, _chunks = decode_entry(raw)
         if pair is None:
             # Sentinel: the step is known to produce no layer.
+            metrics.counter_add("makisu_cache_pull_total", result="empty")
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not self.store.layers.exists(hex_digest):
             if self.registry is None:
                 log.info("cache hit %s but layer %s not local; ignoring",
                          cache_id, hex_digest)
+                metrics.counter_add("makisu_cache_pull_total",
+                                    result="miss")
                 raise CacheMiss(cache_id)
             if self.lazy_enabled():
                 # Materializability must be settled HERE: a hit is a
@@ -160,14 +172,19 @@ class CacheManager:
                 if not remote_ok:
                     log.info("cache hit %s but blob %s gone from the "
                              "registry; ignoring", cache_id, hex_digest)
+                    metrics.counter_add("makisu_cache_pull_total",
+                                        result="miss")
                     raise CacheMiss(cache_id)
                 with self._lock:
                     self._lazy[hex_digest] = raw
                 log.info("cache hit %s -> %s (lazy: blob deferred)",
                          cache_id, hex_digest)
+                metrics.counter_add("makisu_cache_pull_total",
+                                    result="hit")
                 return pair
             self.registry.pull_layer(pair.gzip_descriptor.digest)
         log.info("cache hit %s -> %s", cache_id, hex_digest)
+        metrics.counter_add("makisu_cache_pull_total", result="hit")
         return pair
 
     # -- materialization (the lazy half of pull) --------------------------
@@ -216,12 +233,28 @@ class CacheManager:
 
     # -- push -------------------------------------------------------------
 
+    def _set_push_queue_gauge(self, own_depth: int) -> None:
+        """The queue-depth gauge is label-less, so each manager writing
+        its own depth to the process-global registry would let one
+        build's clean finish zero out another build's wedged push. The
+        global series carries the AGGREGATE in-flight count across all
+        managers; the per-build registry (when bound) sees only this
+        manager's depth."""
+        with _push_gauge_lock:
+            total = _push_inflight_total
+        g = metrics.global_registry()
+        g.gauge_set("makisu_cache_push_queue_depth", total)
+        bound = metrics.active_registry()
+        if bound is not g:
+            bound.gauge_set("makisu_cache_push_queue_depth", own_depth)
+
     def push_cache(self, cache_id: str,
                    pair: DigestPair | None,
                    commit: LayerCommit | None = None) -> None:
         """Record the mapping and push layer + KV entry asynchronously;
         failures never fail the build (reference :210-212)."""
         entry = encode_entry(pair, commit)
+        metrics.counter_add("makisu_cache_push_total")
         with self._lock:
             self._mem[cache_id] = entry
 
@@ -248,18 +281,39 @@ class CacheManager:
                                                  entry) == current:
                                     return
                     except Exception as e:  # noqa: BLE001
+                        metrics.counter_add(
+                            "makisu_cache_kv_retries_total", op="put")
                         log.warning("cache KV put %s failed (try %d): %s",
                                     cache_id, attempt + 1, e)
             except Exception as e:  # noqa: BLE001
+                metrics.counter_add("makisu_cache_push_failures_total")
                 log.warning("async cache push %s failed: %s", cache_id, e)
+
+        def push_and_account() -> None:
+            global _push_inflight_total
+            try:
+                push()
+            finally:
+                with self._lock:
+                    own = sum(1 for p in self._pushes
+                              if p.is_alive()
+                              and p is not threading.current_thread())
+                with _push_gauge_lock:
+                    _push_inflight_total -= 1
+                self._set_push_queue_gauge(own)
 
         import contextvars
         t = threading.Thread(target=contextvars.copy_context().run,
-                             args=(push,), daemon=True,
+                             args=(push_and_account,), daemon=True,
                              name=f"cachepush-{cache_id}")
-        t.start()
+        global _push_inflight_total
         with self._lock:
             self._pushes.append(t)
+            depth = sum(1 for p in self._pushes if p.is_alive()) + 1
+        with _push_gauge_lock:
+            _push_inflight_total += 1
+        self._set_push_queue_gauge(depth)
+        t.start()
 
     def set_entry_packs(self, cache_id: str, packs: list) -> None:
         """Record the chunk->pack mapping on an already-written entry.
@@ -281,6 +335,8 @@ class CacheManager:
                 self.kv.put(cache_id, new_raw)
                 return
             except Exception as e:  # noqa: BLE001
+                metrics.counter_add("makisu_cache_kv_retries_total",
+                                    op="put")
                 log.warning("cache KV pack update %s failed (try %d): "
                             "%s", cache_id, attempt + 1, e)
 
@@ -290,7 +346,12 @@ class CacheManager:
         for t in pending:
             t.join(timeout=self.PUSH_TIMEOUT_SECONDS)
             if t.is_alive():
+                metrics.counter_add("makisu_cache_push_timeouts_total")
                 log.warning("cache push %s still running at timeout", t.name)
+        # Wedged pushes must stay visible: they never decremented the
+        # aggregate, so the global gauge still counts them.
+        self._set_push_queue_gauge(sum(1 for t in pending
+                                       if t.is_alive()))
 
 
 class NoopCacheManager:

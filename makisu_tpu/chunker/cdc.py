@@ -41,6 +41,7 @@ import numpy as np
 
 from makisu_tpu.ops import backend as _backend
 from makisu_tpu.ops import gear, sha256
+from makisu_tpu.utils import metrics
 
 BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
 
@@ -106,6 +107,9 @@ class _LaneBatcher:
         from makisu_tpu.ops import sha256_pallas
         digests = sha256_pallas.sha256_lanes_auto(
             self.data, self.lengths)  # async dispatch
+        metrics.counter_add("makisu_bytes_hashed_total",
+                            sum(n for _, n in self.meta),
+                            backend=sha256_pallas.last_route, path="cdc")
         self.pending.append((digests, self.meta))
         self.meta = []
         # Fresh buffers: the dispatched call may still be consuming the old
@@ -277,6 +281,7 @@ class ChunkSession:
         halo = self._halo
         buf = np.frombuffer(halo + blk, dtype=np.uint8)
         entry = None
+        scan_backend = None  # executing backend when != entry[0]'s tag
         if self._native:
             # Synchronous by design: the scan is faster than a device
             # round trip, so there is nothing to overlap. The C++ scan
@@ -304,8 +309,11 @@ class ChunkSession:
                 words = gear_pallas.gear_bitmap_flat2(
                     qbuf, self.avg_bits,
                     interpret=jax.default_backend() == "cpu")
+                # entry[0] is the READBACK layout tag (v2 words decode
+                # like XLA's), not the executing backend.
                 entry = ("xla", words, len(halo), live, blk,
                          self._scanned)
+                scan_backend = "pallas_v2"
             except Exception as e:  # noqa: BLE001 - kernel plane
                 gear_pallas.mark_v2_broken(e)
         if entry is None and gear_pallas.pallas_enabled():
@@ -329,6 +337,10 @@ class ChunkSession:
         if entry is None:
             words = gear.gear_bitmap(buf, self.avg_bits)  # async dispatch
             entry = ("xla", words, len(halo), live, blk, self._scanned)
+        if scan_backend is None:
+            scan_backend = entry[0]
+        metrics.counter_add("makisu_gear_scan_bytes_total", live,
+                            backend=scan_backend)
         self._inflight.append(entry)
         self._scanned += live
         self._halo = (halo + blk)[-(gear_pallas.HALO):]
@@ -389,6 +401,8 @@ class ChunkSession:
             # hashlib IS the native SHA-256 (OpenSSL, SHA-NI): no lane
             # batching to amortize on a CPU host.
             import hashlib
+            metrics.counter_add("makisu_bytes_hashed_total", len(data),
+                                backend="native", path="cdc")
             self._chunks.append(
                 Chunk(offset, len(data), hashlib.sha256(data).digest()))
             return

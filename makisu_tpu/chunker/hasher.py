@@ -22,6 +22,7 @@ from makisu_tpu.docker.image import (
     Digest,
     DigestPair,
 )
+from makisu_tpu.utils import metrics
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +63,7 @@ class LayerSink:
                  threaded: bool | None = None) -> None:
         import os as _os
         self._tar_digest = hashlib.sha256()
+        self._nbytes = 0  # uncompressed bytes digested (telemetry)
         self._tee = tario.TeeDigest(out)
         self.backend_id = backend_id or tario.gzip_backend_id()
         self._gz = tario.gzip_writer(self._tee, backend_id=self.backend_id)
@@ -112,6 +114,7 @@ class LayerSink:
         if self._queue is not None:
             self._put_checked(bytes(data))
         self._tar_digest.update(data)
+        self._nbytes += len(data)
         if self._queue is None:
             self._gz.write(data)
         self._tap(data)
@@ -146,6 +149,8 @@ class LayerSink:
             gzip_descriptor=Descriptor(
                 MEDIA_TYPE_LAYER, self._tee.size,
                 Digest.from_hex(self._tee.digest.hexdigest())))
+        metrics.counter_add("makisu_bytes_hashed_total", self._nbytes,
+                            backend="python", path="layer_sink")
         return LayerCommit(pair, self._finish_chunks(),
                            gzip_backend_id=self.backend_id)
 
@@ -196,11 +201,14 @@ class _NativeTarWriter:
         # the stream to a RECORDSIZE multiple (cache-identity-bearing).
         import tarfile
         end = b"\0" * (2 * tarfile.BLOCKSIZE)
-        self._offset += len(end)
-        rem = self._offset % tarfile.RECORDSIZE
+        rem = (self._offset + len(end)) % tarfile.RECORDSIZE
         if rem:
             end += b"\0" * (tarfile.RECORDSIZE - rem)
+        self._offset += len(end)
         self._sink._handle.write(end)
+        # The writer streams straight into the C++ handle, bypassing
+        # sink.write — account its bytes for the sink's telemetry.
+        self._sink._nbytes += self._offset
 
     def __enter__(self) -> "_NativeTarWriter":
         return self
@@ -221,6 +229,7 @@ class NativeLayerSink:
                  session=None) -> None:
         from makisu_tpu import native
         self.backend_id = backend_id or tario.gzip_backend_id()
+        self._nbytes = 0  # uncompressed bytes digested (telemetry)
         parts = self.backend_id.split("-")
         backend, level = parts[0], int(parts[1])
         block = int(parts[2]) if backend == "pgzip" else 0
@@ -236,11 +245,14 @@ class NativeLayerSink:
 
     def write(self, data: bytes) -> int:  # parity with LayerSink
         self._handle.write(bytes(data))
+        self._nbytes += len(data)
         return len(data)
 
     def finish(self) -> LayerCommit:
         tar_hex, gz_hex, gz_size, _ = self._handle.finish()
         self._handle.close()
+        metrics.counter_add("makisu_bytes_hashed_total", self._nbytes,
+                            backend="native", path="layer_sink")
         pair = DigestPair(
             tar_digest=Digest.from_hex(tar_hex),
             gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, gz_size,

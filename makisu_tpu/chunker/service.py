@@ -25,6 +25,12 @@ import numpy as np
 
 from makisu_tpu.ops import sha256
 from makisu_tpu.chunker.cdc import _BUCKETS
+from makisu_tpu.utils import metrics
+
+# Batch-size histogram buckets: lane-fill powers of two up to the
+# largest bucket's lane count.
+_FILL_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0)
 
 
 class HashService:
@@ -83,6 +89,10 @@ class HashService:
                     batch.append(q.get(timeout=remaining))
                 except queue.Empty:
                     break
+            metrics.observe("makisu_hash_batch_linger_seconds",
+                            time.monotonic() - t0, bucket=cap)
+            metrics.gauge_set("makisu_hash_queue_depth", q.qsize(),
+                              bucket=cap)
             self._run_batch(cap, lanes, batch)
 
     def _run_batch(self, cap: int, lanes: int, batch) -> None:
@@ -91,6 +101,7 @@ class HashService:
         for i, (chunk, _, _) in enumerate(batch):
             data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
             lengths[i] = len(chunk)
+        t0 = time.monotonic()
         try:
             from makisu_tpu.ops import backend as _backend
             from makisu_tpu.ops import sha256_pallas
@@ -98,6 +109,8 @@ class HashService:
                 sha256_pallas.sha256_lanes_auto(data, lengths),
                 "shared-service digest readback")
         except BaseException as e:  # noqa: BLE001
+            metrics.counter_add("makisu_hash_batch_failures_total",
+                                bucket=cap)
             for _, fut, _ in batch:
                 fut.set_exception(e)
             return
@@ -105,6 +118,19 @@ class HashService:
         owners = {owner for _, _, owner in batch if owner is not None}
         if len(owners) > 1:
             self.cross_build_batches += 1
+            metrics.counter_add("makisu_hash_cross_build_batches_total")
+        # NOTE: the dispatcher thread runs outside any build's context,
+        # so these land in the process-global registry only — correct:
+        # a batch can mix several builds' chunks.
+        metrics.counter_add("makisu_hash_batches_total", bucket=cap)
+        metrics.counter_add("makisu_bytes_hashed_total",
+                            int(lengths.sum()),
+                            backend=sha256_pallas.last_route,
+                            path="service")
+        metrics.observe("makisu_hash_batch_seconds",
+                        time.monotonic() - t0, bucket=cap)
+        metrics.observe("makisu_hash_batch_fill", len(batch),
+                        buckets=_FILL_BUCKETS, bucket=cap)
         for i, (_, fut, _) in enumerate(batch):
             fut.set_result(words[i].astype(">u4").tobytes())
 

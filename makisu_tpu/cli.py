@@ -17,6 +17,7 @@ import sys
 import makisu_tpu
 from makisu_tpu import tario
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 from makisu_tpu.utils import pathutils
 
 
@@ -31,6 +32,9 @@ def make_parser() -> argparse.ArgumentParser:
                         choices=["json", "console"])
     parser.add_argument("--cpu-profile", action="store_true",
                         help="write a cProfile dump to /tmp/makisu-tpu.prof")
+    parser.add_argument("--metrics-out", default="", metavar="FILE",
+                        help="write a JSON telemetry report (span tree + "
+                             "counters) for this command to FILE")
     parser.add_argument("--jax-profile", default="", metavar="DIR",
                         help="capture a JAX/XLA profiler trace (xprof) of "
                              "the accelerator hashing path into DIR")
@@ -448,14 +452,24 @@ def main(argv: list[str] | None = None) -> int:
         import jax
         jax.profiler.start_trace(args.jax_profile)
         jax_trace = True
+    # Every invocation gets its own telemetry registry, bound to this
+    # context exactly like the worker's per-build log sink: concurrent
+    # builds in one worker never mix span trees or counters, while the
+    # process-global registry (the worker's /metrics) still aggregates.
+    registry = metrics.MetricsRegistry()
+    metrics_token = metrics.set_build_registry(registry)
+    code = 1
     try:
-        return handler(args)
+        with metrics.span(args.command or "cli"):
+            code = handler(args)
+        return code
     except Exception as e:  # noqa: BLE001 - top-level CLI boundary
         log.error("failed to execute command: %s", e)
         if args.log_level == "debug":
             raise
         return 1
     finally:
+        metrics.reset_build_registry(metrics_token)
         if jax_trace:
             import jax
             jax.profiler.stop_trace()
@@ -464,6 +478,20 @@ def main(argv: list[str] | None = None) -> int:
             profiler.disable()
             profiler.dump_stats("/tmp/makisu-tpu.prof")
             log.info("cpu profile written to /tmp/makisu-tpu.prof")
+        if args.command == "build":
+            # One greppable line with the build's vital signs; the full
+            # breakdown lives in --metrics-out / the worker's /metrics.
+            log.info("build telemetry", exit_code=code,
+                     **metrics.summary(registry))
+        if args.metrics_out:
+            try:
+                metrics.write_report(args.metrics_out, registry,
+                                     command=args.command or "",
+                                     exit_code=code)
+                log.info("telemetry report written to %s",
+                         args.metrics_out)
+            except OSError as e:
+                log.error("failed to write telemetry report: %s", e)
 
 
 if __name__ == "__main__":

@@ -219,6 +219,8 @@ def sync_bounded(x, what: str, timeout: float | None = None):
     """
     import numpy as np
 
+    from makisu_tpu.utils import metrics
+
     if timeout is None:
         timeout = sync_timeout()
     if timeout <= 0:
@@ -233,14 +235,20 @@ def sync_bounded(x, what: str, timeout: float | None = None):
 
     t = threading.Thread(target=run, daemon=True,
                          name="device-readback")
+    t0 = time.monotonic()
     t.start()
     t.join(timeout)
+    metrics.observe("makisu_device_sync_seconds",
+                    time.monotonic() - t0)
     if t.is_alive():
+        metrics.counter_add("makisu_device_sync_total", result="timeout")
         raise TimeoutError(
             f"{what} did not complete within {timeout:.0f}s "
             "(tunnel wedged mid-build?)")
     if "e" in result:
+        metrics.counter_add("makisu_device_sync_total", result="error")
         raise result["e"]
+    metrics.counter_add("makisu_device_sync_total", result="ok")
     return result["v"]
 
 

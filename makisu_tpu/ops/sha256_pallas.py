@@ -126,6 +126,10 @@ def sha256_lanes_pallas(data: jax.Array, lengths: jax.Array,
 # r3, medium).
 _broken = False
 _parity_ok: dict[tuple[int, int], bool] = {}
+# Route the most recent sha256_lanes_auto call took ("pallas"/"xla"):
+# telemetry tags bytes-hashed counters with the backend that actually
+# ran. Advisory (last-writer-wins across threads), never load-bearing.
+last_route = "xla"
 
 
 def mark_broken(exc: Exception) -> None:
@@ -205,6 +209,7 @@ def sha256_lanes_auto(data, lengths):
     # take (not a 64-multiple, or too small for padding edges) routes
     # straight to XLA without burning the process-wide breaker on a
     # guaranteed probe failure.
+    global last_route
     cap = data.shape[-1]
     if (not _broken
             and cap % 64 == 0 and cap >= 64
@@ -212,7 +217,10 @@ def sha256_lanes_auto(data, lengths):
             and jax.default_backend() != "cpu"
             and _device_parity_ok(*data.shape)):
         try:
-            return sha256_lanes_pallas(data, lengths)
+            result = sha256_lanes_pallas(data, lengths)
+            last_route = "pallas"
+            return result
         except Exception as e:  # noqa: BLE001 - kernel plane
             mark_broken(e)
+    last_route = "xla"
     return sha256.sha256_lanes(data, lengths)

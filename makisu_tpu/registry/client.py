@@ -34,6 +34,7 @@ from makisu_tpu.registry.config import RegistryConfig, config_for
 from makisu_tpu.storage import ImageStore
 from makisu_tpu.utils import httputil
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 from makisu_tpu.utils.httputil import HTTPError, Response, Transport, send
 
 
@@ -403,10 +404,16 @@ class RegistryClient:
                 actual = hashlib.sha256(resp.body).hexdigest()
             else:
                 actual = _sha256_file(tmp)
+            # Bytes crossed the wire whether or not the digest checks
+            # out — count before the mismatch raise.
+            metrics.counter_add("makisu_registry_bytes_total",
+                                os.path.getsize(tmp), direction="pull")
             if actual != hex_digest:
                 raise ValueError(
                     f"pulled blob digest mismatch for {digest}: "
                     f"got sha256:{actual}")
+            metrics.counter_add("makisu_registry_blobs_total",
+                                direction="pull")
             return self.store.layers.link_file(hex_digest, tmp)
         finally:
             os.unlink(tmp)
@@ -479,6 +486,11 @@ class RegistryClient:
             resp = self._get_blob_following_redirects(
                 digest, accepted=(200, 206),
                 headers={"Range": f"bytes={start}-{end - 1}"})
+            # Count before the length check: truncated bodies still
+            # crossed the wire, and failure episodes are exactly when
+            # transfer volume matters.
+            metrics.counter_add("makisu_registry_bytes_total",
+                                len(resp.body), direction="pull")
             if resp.status == 206:
                 if len(resp.body) != end - start:
                     return None
@@ -499,9 +511,11 @@ class RegistryClient:
         digests = {manifest.config.digest}
         digests.update(manifest.layer_digests())
         start = time.time()
-        with ThreadPoolExecutor(self.config.concurrency) as pool:
-            list(pool.map(self.push_layer, digests))
-        self.push_manifest(tag, manifest)
+        with metrics.span("registry_push", registry=self.registry,
+                          repository=self.repository, tag=tag):
+            with ThreadPoolExecutor(self.config.concurrency) as pool:
+                list(pool.map(self.push_layer, digests))
+            self.push_manifest(tag, manifest)
         log.info("pushed %s/%s:%s", self.registry, self.repository, tag,
                  duration=time.time() - start)
 
@@ -534,6 +548,8 @@ class RegistryClient:
             except HTTPError as e:
                 if e.status < 500 or attempt == self.config.retries - 1:
                     raise
+                metrics.counter_add("makisu_registry_retries_total",
+                                    op="push_layer")
                 time.sleep(backoff)
                 backoff *= 2
 
@@ -560,11 +576,18 @@ class RegistryClient:
                 body = f.read()
             self._limiter.wait(len(body))
             sep = "&" if "?" in location else "?"
+            # Bytes-pushed counts the attempt (the body goes on the
+            # wire before a failure status comes back); blobs-pushed
+            # counts completions.
+            metrics.counter_add("makisu_registry_bytes_total",
+                                len(body), direction="push")
             self._send("PUT", f"{location}{sep}digest={digest}",
                        headers={"Content-Type":
                                 "application/octet-stream",
                                 "Content-Length": str(len(body))},
                        body=body, accepted=(201, 204))
+            metrics.counter_add("makisu_registry_blobs_total",
+                                direction="push")
             return
         step = size if (chunk <= 0 or chunk >= size) else chunk
         with open(path, "rb") as f:
@@ -572,6 +595,8 @@ class RegistryClient:
             while off < size:
                 piece = f.read(step)  # one chunk resident at a time
                 self._limiter.wait(len(piece))
+                metrics.counter_add("makisu_registry_bytes_total",
+                                    len(piece), direction="push")
                 resp = self._send(
                     "PATCH", location,
                     headers={
@@ -586,6 +611,8 @@ class RegistryClient:
         sep = "&" if "?" in location else "?"
         self._send("PUT", f"{location}{sep}digest={digest}",
                    accepted=(201, 204))
+        metrics.counter_add("makisu_registry_blobs_total",
+                            direction="push")
 
 
 # Test seam: when set, new_client routes through this factory instead of

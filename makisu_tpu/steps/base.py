@@ -17,6 +17,7 @@ from makisu_tpu.chunker.hasher import LayerCommit
 from makisu_tpu.context import BuildContext
 from makisu_tpu.docker.image import DigestPair, ImageConfig
 from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
 
 
 def chain_cache_id(seed: str, *parts: str) -> str:
@@ -139,20 +140,26 @@ def commit_layer(ctx: BuildContext, step: BuildStep) -> list[DigestPair]:
     fd, tmp = tempfile.mkstemp(dir=ctx.image_store.sandbox_dir,
                                prefix="layertar-")
     try:
-        with os.fdopen(fd, "wb") as out:
-            sink = ctx.hasher.open_layer(out,
-                                         backend_id=ctx.gzip_backend_id)
-            with sink.open_tar() as tw:
-                write_diffs(tw)
-            layer_commit = sink.finish()
-        pair = layer_commit.digest_pair
-        ctx.image_store.layers.link_file(pair.gzip_descriptor.digest.hex(),
-                                         tmp)
-        step.layer_commits.append(layer_commit)
+        with metrics.span("commit_layer", directive=step.directive):
+            with os.fdopen(fd, "wb") as out:
+                sink = ctx.hasher.open_layer(out,
+                                             backend_id=ctx.gzip_backend_id)
+                with sink.open_tar() as tw:
+                    write_diffs(tw)
+                layer_commit = sink.finish()
+            pair = layer_commit.digest_pair
+            ctx.image_store.layers.link_file(
+                pair.gzip_descriptor.digest.hex(), tmp)
+            step.layer_commits.append(layer_commit)
     finally:
         os.unlink(tmp)
     ctx.must_scan = False
     ctx.copy_ops = []
+    metrics.counter_add("makisu_layer_commits_total")
+    metrics.counter_add("makisu_layer_bytes_total",
+                        pair.gzip_descriptor.size)
+    metrics.counter_add("makisu_layer_chunks_total",
+                        len(layer_commit.chunks))
     log.info("committed layer %s (%d bytes, %d chunks)",
              pair.gzip_descriptor.digest, pair.gzip_descriptor.size,
              len(layer_commit.chunks))

@@ -40,7 +40,10 @@ class _JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out: dict[str, Any] = {
             "level": record.levelname.lower(),
-            "ts": round(time.time(), 6),
+            # The record's own creation time, NOT format time: records
+            # drained late (handler contention, worker stream backlog)
+            # must carry the moment they were emitted.
+            "ts": round(record.created, 6),
             "msg": record.getMessage(),
         }
         extra = getattr(record, "fields", None)

@@ -1,0 +1,367 @@
+"""Build telemetry: counters, gauges, histograms, and a span tracer.
+
+Two scopes, mirroring the per-build ``_build_sink`` pattern in
+``utils/logging.py``:
+
+- A process-global registry that aggregates everything the process has
+  done (what the worker's ``GET /metrics`` Prometheus endpoint serves —
+  a scraper wants process totals, not one request's).
+- An optional contextvar-bound per-build registry: every counter/gauge/
+  histogram write lands in BOTH, and spans attach to the innermost
+  bound registry. Threads a build spawns (shell drains, async cache
+  pushes, chunk uploads) carry the context along via
+  ``contextvars.copy_context``, so concurrent worker builds never mix
+  telemetry — the same isolation guarantee the log sinks give.
+
+The span tree is the per-build wall-clock breakdown (``--metrics-out``
+writes it as JSON); counters answer rate questions (cache hit ratio,
+bytes hashed per backend, registry transfer volume).
+
+Everything here is stdlib-only and import-cycle-free, so any module in
+the tree can instrument itself. Telemetry must never fail a build:
+writes are cheap dict updates under a lock, and the public helpers
+swallow nothing — they simply cannot raise on well-formed names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import threading
+import time
+from typing import Any, Iterator
+
+_LabelKey = tuple[tuple[str, str], ...]
+
+# Histogram buckets default to a duration ladder (seconds); metrics
+# with a different shape (batch sizes, fill counts) pass their own on
+# first observation.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0)
+
+
+def _label_key(labels: dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Span:
+    """One timed operation; children nest via the context variable."""
+
+    __slots__ = ("name", "attrs", "start_unix", "duration", "error",
+                 "children", "registry", "_t0")
+
+    def __init__(self, name: str, attrs: dict[str, Any],
+                 registry: "MetricsRegistry") -> None:
+        self.name = name
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        self.start_unix = time.time()
+        self._t0 = time.monotonic()
+        self.duration: float | None = None  # None while still open
+        self.error: str | None = None
+        self.children: list[Span] = []
+        self.registry = registry
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "name": self.name,
+            "start": round(self.start_unix, 6),
+            "duration": (round(self.duration, 6)
+                         if self.duration is not None else None),
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.error:
+            out["error"] = self.error
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class _Hist:
+    __slots__ = ("count", "sum", "min", "max", "buckets", "bucket_counts")
+
+    def __init__(self, buckets: tuple[float, ...]) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self.buckets = buckets
+        self.bucket_counts = [0] * len(buckets)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        # bucket_counts are per-bucket (NON-cumulative); the Prometheus
+        # renderer prefix-sums them into the cumulative form.
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.bucket_counts[i] += 1
+                break
+
+
+class MetricsRegistry:
+    """Counters/gauges/histograms plus a span-tree root. Thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[_LabelKey, float]] = {}
+        self._gauges: dict[str, dict[_LabelKey, float]] = {}
+        self._hists: dict[str, dict[_LabelKey, _Hist]] = {}
+        self.root = Span("root", {}, self)
+
+    # -- writes -----------------------------------------------------------
+
+    def counter_add(self, name: str, value: float = 1.0,
+                    **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, **labels: Any) -> None:
+        with self._lock:
+            self._gauges.setdefault(name, {})[_label_key(labels)] = \
+                float(value)
+
+    def observe(self, name: str, value: float,
+                buckets: tuple[float, ...] | None = None,
+                **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._hists.setdefault(name, {})
+            hist = series.get(key)
+            if hist is None:
+                hist = series[key] = _Hist(buckets or DEFAULT_BUCKETS)
+            hist.observe(value)
+
+    # -- reads ------------------------------------------------------------
+
+    def counter_total(self, name: str, **labels: Any) -> float:
+        """Sum of every series of ``name`` whose labels are a superset
+        of the given ones (no labels: the metric's grand total)."""
+        want = set(_label_key(labels))
+        with self._lock:
+            series = self._counters.get(name, {})
+            return sum(v for k, v in series.items() if want <= set(k))
+
+    def counter_by_label(self, name: str, label: str) -> dict[str, float]:
+        """Grand total of ``name`` broken down by one label's values."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for key, value in self._counters.get(name, {}).items():
+                for k, v in key:
+                    if k == label:
+                        out[v] = out.get(v, 0.0) + value
+        return out
+
+    def report(self) -> dict[str, Any]:
+        """JSON-ready build report: span tree + every metric series."""
+
+        def series_list(table: dict[str, dict[_LabelKey, float]]):
+            return {
+                name: [{"labels": dict(key), "value": value}
+                       for key, value in sorted(series.items())]
+                for name, series in sorted(table.items())
+            }
+
+        with self._lock:
+            hists = {
+                name: [{
+                    "labels": dict(key),
+                    "count": h.count,
+                    "sum": round(h.sum, 6),
+                    "min": h.min,
+                    "max": h.max,
+                } for key, h in sorted(series.items())]
+                for name, series in sorted(self._hists.items())
+            }
+            counters = series_list(self._counters)
+            gauges = series_list(self._gauges)
+            spans = [c.to_dict() for c in self.root.children]
+        return {
+            "schema": "makisu-tpu.metrics.v1",
+            "spans": spans,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+
+
+# -- scoping ---------------------------------------------------------------
+
+_global = MetricsRegistry()
+
+_build_registry: "contextvars.ContextVar[MetricsRegistry | None]" = \
+    contextvars.ContextVar("makisu_build_metrics", default=None)
+_current_span: "contextvars.ContextVar[Span | None]" = \
+    contextvars.ContextVar("makisu_current_span", default=None)
+
+
+def global_registry() -> MetricsRegistry:
+    return _global
+
+
+def set_build_registry(registry: MetricsRegistry | None):
+    """Bind a per-context registry (worker mode: one per /build).
+    Returns a token for ``reset_build_registry``."""
+    return _build_registry.set(registry)
+
+
+def reset_build_registry(token) -> None:
+    _build_registry.reset(token)
+
+
+def active_registry() -> MetricsRegistry:
+    return _build_registry.get() or _global
+
+
+def _targets() -> tuple[MetricsRegistry, ...]:
+    bound = _build_registry.get()
+    if bound is None or bound is _global:
+        return (_global,)
+    return (_global, bound)
+
+
+def counter_add(name: str, value: float = 1.0, **labels: Any) -> None:
+    for reg in _targets():
+        reg.counter_add(name, value, **labels)
+
+
+def gauge_set(name: str, value: float, **labels: Any) -> None:
+    for reg in _targets():
+        reg.gauge_set(name, value, **labels)
+
+
+def observe(name: str, value: float,
+            buckets: tuple[float, ...] | None = None,
+            **labels: Any) -> None:
+    for reg in _targets():
+        reg.observe(name, value, buckets=buckets, **labels)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[Span]:
+    """Timed scope attached to the innermost bound registry's tree.
+    Nested spans become children; exceptions mark the span and
+    propagate (telemetry never swallows a build failure)."""
+    reg = active_registry()
+    parent = _current_span.get()
+    if parent is None or parent.registry is not reg:
+        parent = reg.root
+    s = Span(name, attrs, reg)
+    with reg._lock:
+        parent.children.append(s)
+    token = _current_span.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        s.duration = time.monotonic() - s._t0
+        _current_span.reset(token)
+
+
+# -- renderers -------------------------------------------------------------
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_value(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _fmt_labels(key: _LabelKey, extra: tuple[tuple[str, str], ...] = ()
+                ) -> str:
+    pairs = key + extra
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def render_prometheus(registry: MetricsRegistry | None = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of a registry —
+    default: the process-global one (what ``GET /metrics`` serves)."""
+    reg = registry if registry is not None else _global
+    lines: list[str] = []
+    with reg._lock:
+        for name in sorted(reg._counters):
+            lines.append(f"# TYPE {name} counter")
+            for key, value in sorted(reg._counters[name].items()):
+                lines.append(f"{name}{_fmt_labels(key)} "
+                             f"{_fmt_value(value)}")
+        for name in sorted(reg._gauges):
+            lines.append(f"# TYPE {name} gauge")
+            for key, value in sorted(reg._gauges[name].items()):
+                lines.append(f"{name}{_fmt_labels(key)} "
+                             f"{_fmt_value(value)}")
+        for name in sorted(reg._hists):
+            lines.append(f"# TYPE {name} histogram")
+            for key, h in sorted(reg._hists[name].items()):
+                cumulative = 0
+                for le, n in zip(h.buckets, h.bucket_counts):
+                    cumulative += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(key, (('le', _fmt_value(le)),))} "
+                        f"{cumulative}")
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(key, (('le', '+Inf'),))}"
+                    f" {h.count}")
+                lines.append(f"{name}_sum{_fmt_labels(key)} "
+                             f"{_fmt_value(h.sum)}")
+                lines.append(f"{name}_count{_fmt_labels(key)} {h.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def summary(registry: MetricsRegistry | None = None) -> dict[str, Any]:
+    """Flat key/value digest of one build's registry — the fields the
+    final ``info("build telemetry", ...)`` line carries."""
+    reg = registry if registry is not None else active_registry()
+    out: dict[str, Any] = {}
+    with reg._lock:
+        top = reg.root.children[0] if reg.root.children else None
+    duration = top.duration if top is not None else None
+    if duration is not None:
+        out["duration_seconds"] = round(duration, 3)
+    out["cache_hits"] = int(reg.counter_total(
+        "makisu_cache_pull_total", result="hit"))
+    out["cache_misses"] = int(reg.counter_total(
+        "makisu_cache_pull_total", result="miss"))
+    out["layers_committed"] = int(reg.counter_total(
+        "makisu_layer_commits_total"))
+    hashed = reg.counter_by_label("makisu_bytes_hashed_total", "backend")
+    for backend, nbytes in sorted(hashed.items()):
+        out[f"hashed_bytes_{backend}"] = int(nbytes)
+    total_hashed = sum(hashed.values())
+    out["hashed_bytes"] = int(total_hashed)
+    if duration:
+        out["hashed_bytes_per_sec"] = int(total_hashed / duration)
+    out["registry_pull_bytes"] = int(reg.counter_total(
+        "makisu_registry_bytes_total", direction="pull"))
+    out["registry_push_bytes"] = int(reg.counter_total(
+        "makisu_registry_bytes_total", direction="push"))
+    return out
+
+
+def write_report(path: str,
+                 registry: MetricsRegistry | None = None,
+                 **extra: Any) -> None:
+    """Write a build's JSON telemetry report (the ``--metrics-out``
+    payload): span tree + counters, plus any caller extras (exit code,
+    argv)."""
+    reg = registry if registry is not None else active_registry()
+    payload = reg.report()
+    payload.update(extra)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")

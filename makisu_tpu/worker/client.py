@@ -37,6 +37,9 @@ class WorkerClient:
         self.local_shared_path = local_shared_path
         self.worker_shared_path = worker_shared_path
         self.timeout = timeout
+        # Terminal payload of the last build() call: exit_code and
+        # elapsed_seconds as data, no log-text parsing needed.
+        self.last_build: dict = {}
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         conn = _UnixHTTPConnection(self.socket_path, self.timeout)
@@ -55,6 +58,17 @@ class WorkerClient:
                 conn.close()
         except OSError:
             return False
+
+    def metrics(self) -> str:
+        """The worker's Prometheus text exposition (``GET /metrics``)."""
+        conn, resp = self._request("GET", "/metrics")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /metrics returned {resp.status}")
+            return resp.read().decode()
+        finally:
+            conn.close()
 
     def exit(self) -> None:
         try:
@@ -86,6 +100,7 @@ class WorkerClient:
         if context_dir is not None:
             worker_ctx = self.prepare_context(context_dir)
             argv = list(argv) + [worker_ctx]
+        self.last_build = {}  # stale outcome must not survive a retry
         conn, resp = self._request("POST", "/build",
                                    json.dumps(argv).encode())
         build_code = 1
@@ -110,6 +125,7 @@ class WorkerClient:
                         continue
                     if "build_code" in payload:
                         build_code = int(payload["build_code"])
+                        self.last_build = payload
                     else:
                         if on_line is not None:
                             on_line(payload)

@@ -16,7 +16,11 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
+
+# Prometheus text exposition content type (format 0.0.4).
+_METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -28,6 +32,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         if self.path == "/ready":
             self._respond(200, b"ok")
+        elif self.path == "/metrics":
+            # Process-wide totals across every build this worker has
+            # served — what a scraper wants. Per-build breakdowns come
+            # from each build's own --metrics-out report.
+            from makisu_tpu.utils import metrics
+            self._respond(200, metrics.render_prometheus().encode(),
+                          content_type=_METRICS_CONTENT_TYPE)
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -69,15 +80,27 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self.wfile.write(frame)
 
+        start = time.monotonic()
         code = self.server.run_build(argv, emit)
-        emit(json.dumps({"build_code": str(code)}))
+        # Terminal line carries the outcome as DATA — exit code and
+        # elapsed seconds — so clients never parse log text for it.
+        # "build_code" (stringly) predates "exit_code"; kept for older
+        # clients.
+        emit(json.dumps({
+            "build_code": str(code),
+            "exit_code": code,
+            "elapsed_seconds": round(time.monotonic() - start, 3),
+        }))
         with emit_lock:
             finished.set()
             self.wfile.write(b"0\r\n\r\n")
 
-    def _respond(self, status: int, body: bytes) -> None:
+    def _respond(self, status: int, body: bytes,
+                 content_type: str | None = None) -> None:
         try:
             self.send_response(status)
+            if content_type:
+                self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -201,14 +224,20 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         locks = self._shared_path_locks(argv)
         for lock in locks:
             lock.acquire()
+        code = 1
         try:
-            return cli.main(argv)
+            code = cli.main(argv)
+            return code
         except SystemExit as e:
-            return int(e.code or 0)
+            code = int(e.code or 0)
+            return code
         except Exception as e:  # noqa: BLE001 - worker must survive
             emit(json.dumps({"level": "error", "msg": str(e)}))
             return 1
         finally:
+            from makisu_tpu.utils import metrics
+            metrics.counter_add("makisu_worker_builds_total",
+                                result="ok" if code == 0 else "error")
             for lock in reversed(locks):
                 lock.release()
             log.reset_build_sink(token)
