@@ -24,6 +24,7 @@ from makisu_tpu.docker.image import (
     ImageName,
 )
 from makisu_tpu.steps import FromStep, new_step
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -126,10 +127,17 @@ class BuildStage:
             log.info("step %d/%d (%s): %s", i + 1, len(self.nodes), opts,
                      node)
             start = time.time()
+            events.emit("step", phase="start", stage=self.alias, index=i,
+                        directive=node.step.directive,
+                        cached=node.digest_pairs is not None,
+                        skip=bool(opts.skip_build))
             with metrics.span("step", directive=node.step.directive,
                               index=i, cached=node.digest_pairs is not None,
                               skip=opts.skip_build):
                 config = node.build(cache_mgr, config, opts)
+            events.emit("step", phase="done", stage=self.alias, index=i,
+                        directive=node.step.directive,
+                        duration=round(time.time() - start, 6))
             log.info("step %d done", i + 1, duration=time.time() - start)
             if node.digest_pairs:
                 for pair in node.digest_pairs:

@@ -25,6 +25,8 @@ import json
 from makisu_tpu import tario
 from makisu_tpu.docker.image import Digest, DigestPair
 from makisu_tpu.storage.cas import CASStore
+from makisu_tpu.utils import concurrency
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -384,9 +386,11 @@ class ChunkStore:
                 return False
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(8) as pool:
-            ok = list(pool.map(self._fetch_remote, missing))
+            ok = concurrency.ctx_map(pool, self._fetch_remote, missing)
         metrics.counter_add("makisu_chunks_fetched_total", sum(ok),
                             route="blob")
+        events.emit("chunk_fetch", route="blob", fetched=sum(ok),
+                    requested=len(missing))
         return all(ok)
 
     # Coalesce needed spans within a pack when the gap between them is
@@ -512,7 +516,7 @@ class ChunkStore:
                         return
 
             with ThreadPoolExecutor(8) as pool:
-                list(pool.map(fetch_pack_runs, run_jobs))
+                concurrency.ctx_map(pool, fetch_pack_runs, run_jobs)
             whole_jobs.extend(sorted(range_failed))
         n_requests = len(requests_issued)
 
@@ -542,6 +546,8 @@ class ChunkStore:
         if got:
             metrics.counter_add("makisu_chunks_fetched_total", len(got),
                                 route="pack")
+            events.emit("chunk_fetch", route="pack", fetched=len(got),
+                        requested=len(missing), requests=n_requests)
             log.info("fetched %d/%d missing chunks from %d pack(s) in "
                      "%d request(s)", len(got), len(missing),
                      len(by_pack), n_requests)
@@ -805,7 +811,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                             failed.append((hex_digest, e))
 
                     with ThreadPoolExecutor(8) as pool:
-                        list(pool.map(push_one, added))
+                        concurrency.ctx_map(pool, push_one, added)
                     if failed:
                         log.warning("chunk push failed for %d/%d "
                                     "chunks (first: %s: %s)",
@@ -871,10 +877,12 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         raw = manager._get_raw(cache_id)
         if raw is None:
             metrics.counter_add("makisu_cache_pull_total", result="miss")
+            events.emit("cache", result="miss", cache_id=cache_id)
             raise CacheMiss(cache_id)
         pair, chunks, gz_backend, packs = decode_entry_full(raw)
         if pair is None:
             metrics.counter_add("makisu_cache_pull_total", result="empty")
+            events.emit("cache", result="empty", cache_id=cache_id)
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not manager.store.layers.exists(hex_digest) and chunks:
@@ -889,6 +897,9 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 metrics.counter_add("makisu_cache_pull_total",
                                     result="hit")
                 metrics.counter_add("makisu_cache_chunk_route_hits_total")
+                events.emit("cache", result="hit", cache_id=cache_id,
+                            layer=hex_digest, route="chunks",
+                            chunks=len(chunks))
                 log.info("cache hit %s -> %s (lazy: %d chunks "
                          "available)", cache_id, hex_digest, len(chunks))
                 if not manager.lazy_enabled():

@@ -14,6 +14,8 @@ import threading
 import time
 import urllib.request
 
+from makisu_tpu.utils import metrics
+
 
 class MemoryStore:
     """In-memory store (tests and single-process builds)."""
@@ -248,8 +250,18 @@ class HTTPStore:
     def _url(self, key: str) -> str:
         return f"{self.base.rstrip('/')}/{key}"
 
+    def _request_headers(self) -> dict[str, str]:
+        # traceparent on every KV exchange: cache lookups/writes are on
+        # the warm-build hot path, so a slow build must be correlatable
+        # with the KV server's own request logs. The configured headers
+        # win on collision (an auth-fronted cache may pin its own).
+        headers = {"traceparent": metrics.current_traceparent()}
+        headers.update(self.headers)
+        return headers
+
     def get(self, key: str) -> str | None:
-        req = urllib.request.Request(self._url(key), headers=self.headers)
+        req = urllib.request.Request(self._url(key),
+                                     headers=self._request_headers())
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.read().decode()
@@ -259,7 +271,7 @@ class HTTPStore:
     def put(self, key: str, value: str) -> None:
         req = urllib.request.Request(
             self._url(key), data=value.encode(), method="PUT",
-            headers=self.headers)
+            headers=self._request_headers())
         with urllib.request.urlopen(req, timeout=self.timeout):
             pass
 

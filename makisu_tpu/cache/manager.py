@@ -22,6 +22,7 @@ from makisu_tpu.docker.image import (
     Digest,
     DigestPair,
 )
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -141,11 +142,13 @@ class CacheManager:
         raw = self._get_raw(cache_id)
         if raw is None:
             metrics.counter_add("makisu_cache_pull_total", result="miss")
+            events.emit("cache", result="miss", cache_id=cache_id)
             raise CacheMiss(cache_id)
         pair, _chunks = decode_entry(raw)
         if pair is None:
             # Sentinel: the step is known to produce no layer.
             metrics.counter_add("makisu_cache_pull_total", result="empty")
+            events.emit("cache", result="empty", cache_id=cache_id)
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not self.store.layers.exists(hex_digest):
@@ -154,6 +157,8 @@ class CacheManager:
                          cache_id, hex_digest)
                 metrics.counter_add("makisu_cache_pull_total",
                                     result="miss")
+                events.emit("cache", result="miss", cache_id=cache_id,
+                            reason="layer_not_local")
                 raise CacheMiss(cache_id)
             if self.lazy_enabled():
                 # Materializability must be settled HERE: a hit is a
@@ -174,6 +179,8 @@ class CacheManager:
                              "registry; ignoring", cache_id, hex_digest)
                     metrics.counter_add("makisu_cache_pull_total",
                                         result="miss")
+                    events.emit("cache", result="miss", cache_id=cache_id,
+                                reason="blob_gone")
                     raise CacheMiss(cache_id)
                 with self._lock:
                     self._lazy[hex_digest] = raw
@@ -181,10 +188,14 @@ class CacheManager:
                          cache_id, hex_digest)
                 metrics.counter_add("makisu_cache_pull_total",
                                     result="hit")
+                events.emit("cache", result="hit", cache_id=cache_id,
+                            layer=hex_digest, lazy=True)
                 return pair
             self.registry.pull_layer(pair.gzip_descriptor.digest)
         log.info("cache hit %s -> %s", cache_id, hex_digest)
         metrics.counter_add("makisu_cache_pull_total", result="hit")
+        events.emit("cache", result="hit", cache_id=cache_id,
+                    layer=hex_digest)
         return pair
 
     # -- materialization (the lazy half of pull) --------------------------

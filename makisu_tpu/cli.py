@@ -10,15 +10,24 @@ chunk fingerprints into the distributed cache).
 from __future__ import annotations
 
 import argparse
+import contextvars
 import cProfile
 import os
 import sys
 
 import makisu_tpu
 from makisu_tpu import tario
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 from makisu_tpu.utils import pathutils
+
+# How this invocation was launched, for the build_info gauge. The
+# worker sets "worker" around each in-process cli.main call —
+# context-scoped, not process env, so a process that hosts a worker
+# AND runs standalone builds labels each correctly.
+invocation_mode: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "makisu_invocation_mode", default="standalone")
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -35,6 +44,12 @@ def make_parser() -> argparse.ArgumentParser:
     parser.add_argument("--metrics-out", default="", metavar="FILE",
                         help="write a JSON telemetry report (span tree + "
                              "counters) for this command to FILE")
+    parser.add_argument("--events-out", default="", metavar="FILE",
+                        help="write this command's build events (JSONL, "
+                             "one event per line) to FILE as they happen")
+    parser.add_argument("--trace-out", default="", metavar="FILE",
+                        help="write a Chrome/Perfetto trace-event JSON of "
+                             "this command's span tree to FILE")
     parser.add_argument("--jax-profile", default="", metavar="DIR",
                         help="capture a JAX/XLA profiler trace (xprof) of "
                              "the accelerator hashing path into DIR")
@@ -128,6 +143,13 @@ def make_parser() -> argparse.ArgumentParser:
     worker = sub.add_parser("worker", help="run a long-lived build worker")
     worker.add_argument("--socket", default="/tmp/makisu-tpu-worker.sock",
                         help="unix socket to listen on")
+
+    report = sub.add_parser(
+        "report", help="critical-path analysis of a telemetry report")
+    report.add_argument("metrics_file",
+                        help="a --metrics-out JSON report to analyze")
+    report.add_argument("--events", default="", metavar="FILE",
+                        help="an --events-out JSONL log to include")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -411,6 +433,36 @@ def _deep_diff(a, b, path: str = "") -> list[str]:
     return []
 
 
+def cmd_report(args) -> int:
+    """Critical-path analysis of a build's telemetry: where the wall
+    time went, what to attack first. Input is a ``--metrics-out`` JSON
+    report (and optionally the matching ``--events-out`` log)."""
+    import json as json_mod
+
+    from makisu_tpu.utils import events as events_mod
+    from makisu_tpu.utils import traceexport
+
+    with open(args.metrics_file, encoding="utf-8") as f:
+        report = json_mod.load(f)
+    if report.get("schema") != "makisu-tpu.metrics.v1":
+        raise SystemExit(
+            f"{args.metrics_file}: not a makisu-tpu metrics report "
+            f"(schema {report.get('schema')!r})")
+    event_log = None
+    if args.events:
+        try:
+            event_log = events_mod.read_jsonl(args.events)
+        except ValueError as e:
+            # A build killed mid-write leaves one torn final line —
+            # exactly the case a post-mortem report is FOR. Analyze
+            # the valid prefix instead of dying.
+            log.warning("%s; analyzing the valid lines only", e)
+            event_log = events_mod.read_jsonl(args.events,
+                                              skip_invalid=True)
+    print(traceexport.render_report(report, event_log), end="")
+    return 0
+
+
 def cmd_worker(args) -> int:
     from makisu_tpu.worker import WorkerServer
     server = WorkerServer(args.socket)
@@ -433,7 +485,8 @@ def main(argv: list[str] | None = None) -> int:
         print(makisu_tpu.BUILD_HASH)
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
-                "diff": cmd_diff, "worker": cmd_worker}
+                "diff": cmd_diff, "worker": cmd_worker,
+                "report": cmd_report}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -458,6 +511,30 @@ def main(argv: list[str] | None = None) -> int:
     # process-global registry (the worker's /metrics) still aggregates.
     registry = metrics.MetricsRegistry()
     metrics_token = metrics.set_build_registry(registry)
+    # Deploy-identity info gauge: constant 1, identity in the labels
+    # (the node_exporter "build_info" idiom). Scrapers join it against
+    # rate() series to slice by version/hasher/platform/mode.
+    metrics.gauge_set(
+        "makisu_build_info", 1,
+        version=makisu_tpu.__version__,
+        command=args.command or "",
+        hasher=getattr(args, "hasher", "") or "",
+        platform=os.environ.get("JAX_PLATFORMS", "") or "default",
+        mode=invocation_mode.get())
+    events_writer = None
+    events_token = None
+    if args.events_out:
+        try:
+            events_writer = events.JsonlWriter(args.events_out)
+            events_token = events.add_sink(events_writer)
+        except OSError as e:
+            log.error("failed to open events log %s: %s",
+                      args.events_out, e)
+    # argv deliberately stays out of the event record: it can carry
+    # credentials (--redis-cache-password, registry configs).
+    events.emit("build_start", trace_id=registry.trace_id,
+                command=args.command or "",
+                version=makisu_tpu.__version__)
     code = 1
     try:
         with metrics.span(args.command or "cli"):
@@ -469,6 +546,13 @@ def main(argv: list[str] | None = None) -> int:
             raise
         return 1
     finally:
+        events.emit("build_end", trace_id=registry.trace_id,
+                    exit_code=code)
+        if events_token is not None:
+            events.reset_sink(events_token)
+        if events_writer is not None:
+            events_writer.close()
+            log.info("event log written to %s", args.events_out)
         metrics.reset_build_registry(metrics_token)
         if jax_trace:
             import jax
@@ -483,15 +567,29 @@ def main(argv: list[str] | None = None) -> int:
             # breakdown lives in --metrics-out / the worker's /metrics.
             log.info("build telemetry", exit_code=code,
                      **metrics.summary(registry))
-        if args.metrics_out:
-            try:
-                metrics.write_report(args.metrics_out, registry,
-                                     command=args.command or "",
-                                     exit_code=code)
-                log.info("telemetry report written to %s",
-                         args.metrics_out)
-            except OSError as e:
-                log.error("failed to write telemetry report: %s", e)
+        if args.metrics_out or args.trace_out:
+            # One registry.report() feeds both files — the span tree
+            # and counter tables are not walked twice per build.
+            report = registry.report()
+            report["command"] = args.command or ""
+            report["exit_code"] = code
+            if args.metrics_out:
+                try:
+                    metrics.write_json_atomic(args.metrics_out, report)
+                    log.info("telemetry report written to %s",
+                             args.metrics_out)
+                except OSError as e:
+                    log.error("failed to write telemetry report: %s", e)
+            if args.trace_out:
+                try:
+                    from makisu_tpu.utils import traceexport
+                    metrics.write_json_atomic(
+                        args.trace_out,
+                        traceexport.perfetto_trace(report))
+                    log.info("perfetto trace written to %s",
+                             args.trace_out)
+                except OSError as e:
+                    log.error("failed to write perfetto trace: %s", e)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,8 @@ from makisu_tpu.docker.image import (  # noqa: F401 - re-export surface
 )
 from makisu_tpu.registry.config import RegistryConfig, config_for
 from makisu_tpu.storage import ImageStore
+from makisu_tpu.utils import concurrency
+from makisu_tpu.utils import events
 from makisu_tpu.utils import httputil
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
@@ -247,7 +249,7 @@ class RegistryClient:
         digests.update(manifest.layer_digests())
         start = time.time()
         with ThreadPoolExecutor(self.config.concurrency) as pool:
-            list(pool.map(self.pull_layer, digests))
+            concurrency.ctx_map(pool, self.pull_layer, digests)
         log.info("pulled %s/%s:%s", self.registry, self.repository, tag,
                  duration=time.time() - start)
         if isinstance(name, ImageName):
@@ -414,6 +416,10 @@ class RegistryClient:
                     f"got sha256:{actual}")
             metrics.counter_add("makisu_registry_blobs_total",
                                 direction="pull")
+            events.emit("registry_blob", direction="pull",
+                        digest=hex_digest,
+                        bytes=os.path.getsize(tmp),
+                        registry=self.registry)
             return self.store.layers.link_file(hex_digest, tmp)
         finally:
             os.unlink(tmp)
@@ -514,7 +520,7 @@ class RegistryClient:
         with metrics.span("registry_push", registry=self.registry,
                           repository=self.repository, tag=tag):
             with ThreadPoolExecutor(self.config.concurrency) as pool:
-                list(pool.map(self.push_layer, digests))
+                concurrency.ctx_map(pool, self.push_layer, digests)
             self.push_manifest(tag, manifest)
         log.info("pushed %s/%s:%s", self.registry, self.repository, tag,
                  duration=time.time() - start)
@@ -588,6 +594,9 @@ class RegistryClient:
                        body=body, accepted=(201, 204))
             metrics.counter_add("makisu_registry_blobs_total",
                                 direction="push")
+            events.emit("registry_blob", direction="push",
+                        digest=digest.hex(), bytes=len(body),
+                        registry=self.registry)
             return
         step = size if (chunk <= 0 or chunk >= size) else chunk
         with open(path, "rb") as f:
@@ -613,6 +622,9 @@ class RegistryClient:
                    accepted=(201, 204))
         metrics.counter_add("makisu_registry_blobs_total",
                             direction="push")
+        events.emit("registry_blob", direction="push",
+                    digest=digest.hex(), bytes=size,
+                    registry=self.registry)
 
 
 # Test seam: when set, new_client routes through this factory instead of

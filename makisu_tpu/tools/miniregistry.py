@@ -113,6 +113,11 @@ class _State:
         # Byte accounting for benchmarks: blob bytes served / accepted.
         self.blob_bytes_out = 0
         self.blob_bytes_in = 0
+        # Request log: (method, path, traceparent header or ""). What a
+        # real registry's access log would hold — tests assert trace
+        # propagation against it (every request a build issues must
+        # carry the build's trace id).
+        self.requests: list[tuple[str, str, str]] = []
 
     def repo(self, name: str) -> _Repo:
         return self.repos.setdefault(name, _Repo())
@@ -198,6 +203,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch("PUT")
 
     def _dispatch(self, verb: str) -> None:
+        with self.st.lock:
+            self.st.requests.append(
+                (verb, self.path.split("?")[0],
+                 self.headers.get("traceparent", "")))
         kind, groups, query = self._route()
         handler = getattr(self, f"_{verb.lower()}_{kind}", None)
         if kind == "" or handler is None:

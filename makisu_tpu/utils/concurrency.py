@@ -1,4 +1,4 @@
-"""Bounded worker pool.
+"""Bounded worker pool and context-propagating parallel map.
 
 Reference capability: lib/concurrency/worker_pool.go (fixed-N goroutine
 pool; Do blocks when the queue is full; Stop/Wait join). Python's
@@ -8,9 +8,23 @@ means unbounded memory; this pool applies backpressure instead.
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
-from typing import Callable
+from typing import Any, Callable, Iterable
+
+
+def ctx_map(pool, fn: Callable[[Any], Any],
+            items: Iterable[Any]) -> list:
+    """``pool.map`` with the caller's contextvars carried into every
+    task. Pool worker threads start with an EMPTY context, so without
+    this a parallel layer transfer loses the build's telemetry
+    registry — its requests would stamp the process-global trace id
+    instead of the build's, and its counters would miss the per-build
+    report. Each task runs in its own copy of the caller's context
+    (one ``Context`` object cannot be entered concurrently)."""
+    jobs = [(contextvars.copy_context(), item) for item in items]
+    return list(pool.map(lambda job: job[0].run(fn, job[1]), jobs))
 
 
 class WorkerPool:
@@ -43,10 +57,13 @@ class WorkerPool:
             self._tasks.task_done()
 
     def submit(self, fn: Callable[[], None]) -> None:
-        """Enqueue work; blocks when the queue is full (backpressure)."""
+        """Enqueue work; blocks when the queue is full (backpressure).
+        The submitter's contextvars (build telemetry registry, log
+        sink) travel with the task, same as :func:`ctx_map`."""
         if self._stopped.is_set():
             raise RuntimeError("pool is stopped")
-        self._tasks.put(fn)
+        ctx = contextvars.copy_context()
+        self._tasks.put(lambda: ctx.run(fn))
 
     def stop(self) -> None:
         """Drop not-yet-started tasks and join workers."""

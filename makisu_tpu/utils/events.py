@@ -1,0 +1,127 @@
+"""Build event bus: the durable, streamable record of one build.
+
+Spans (utils/metrics.py) answer "how long"; events answer "what
+happened, in order, with what identity" — and unlike the span tree,
+which only materializes when the build ends, events leave the process
+the moment they occur. Three consumers:
+
+- ``--events-out FILE``: a per-build JSONL event log (one JSON object
+  per line), written through :class:`JsonlWriter`.
+- The worker's ``/build`` response stream: each event rides as its own
+  NDJSON frame (``{"event": {...}}``), interleaved with log-line
+  frames, so a client watches a build's structure live.
+- Tests/tools: any callable sink.
+
+Scoping mirrors the per-build log sink in ``utils/logging.py`` and the
+metrics contextvar: sinks bind to the current context, threads a build
+spawns inherit them via ``contextvars.copy_context``, and concurrent
+worker builds never see each other's events. With no sink bound,
+``emit`` is a tuple-read no-op — instrumentation sites pay nothing.
+
+Event shape: ``{"ts": <unix seconds>, "type": <str>, ...fields}``.
+Types emitted today: ``build_start``/``build_end`` (cli.py),
+``span_start``/``span_end`` (metrics.span), ``step`` (builder/stage.py,
+``phase=start|done``), ``cache`` (cache/manager.py + cache/chunks.py,
+``result=hit|miss|empty``), ``chunk_fetch`` (cache/chunks.py), and
+``registry_blob`` (registry/client.py). The set is open: any module may
+emit new types; consumers must ignore types they don't know.
+
+Like the rest of the telemetry layer: stdlib-only, import-cycle-free,
+and never able to fail a build — a raising sink is swallowed.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from typing import Any, Callable
+
+EventSink = Callable[[dict], None]
+
+_sinks: "contextvars.ContextVar[tuple[EventSink, ...]]" = \
+    contextvars.ContextVar("makisu_event_sinks", default=())
+
+
+def add_sink(sink: EventSink):
+    """Bind an event sink in the current context (stacking on any
+    already bound). Returns a token for :func:`reset_sink`."""
+    return _sinks.set(_sinks.get() + (sink,))
+
+
+def reset_sink(token) -> None:
+    _sinks.reset(token)
+
+
+def active() -> bool:
+    """Whether any sink is bound in this context (lets callers skip
+    building expensive event payloads)."""
+    return bool(_sinks.get())
+
+
+def emit(event_type: str, **fields: Any) -> None:
+    """Deliver one event to every bound sink. No sink: free no-op.
+    A sink that raises is ignored — events must never fail a build."""
+    sinks = _sinks.get()
+    if not sinks:
+        return
+    event: dict[str, Any] = {"ts": round(time.time(), 6),
+                             "type": event_type}
+    event.update(fields)
+    for sink in sinks:
+        try:
+            sink(event)
+        except Exception:  # noqa: BLE001 - a dead sink must not kill a build
+            pass
+
+
+class JsonlWriter:
+    """Append-only JSONL event sink (the ``--events-out`` file).
+
+    Each event is one line, written and flushed under a lock so the
+    concurrent writers a build spawns (cache pushes, chunk uploads,
+    shell drains) can't interleave partial lines — a killed build
+    leaves at worst one truncated FINAL line, and every line before it
+    stays valid JSON."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f = open(path, "w", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def __call__(self, event: dict) -> None:
+        line = json.dumps(event, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()
+
+
+def read_jsonl(path: str, skip_invalid: bool = False) -> list[dict]:
+    """Load an event log, skipping blank lines. A truncated final line
+    (build killed mid-write) raises ``ValueError`` naming the line
+    number; ``skip_invalid=True`` drops unparseable lines instead and
+    keeps the valid ones — the salvage mode ``makisu-tpu report`` uses
+    on logs of killed builds."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError as e:
+                if skip_invalid:
+                    continue
+                raise ValueError(
+                    f"{path}:{i}: invalid event JSON: {e}") from e
+    return out

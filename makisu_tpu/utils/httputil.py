@@ -21,6 +21,8 @@ import urllib.error
 import urllib.request
 from typing import BinaryIO
 
+from makisu_tpu.utils import metrics
+
 RETRYABLE_CODES = {408, 502, 503, 504}
 
 
@@ -161,8 +163,15 @@ def send(transport: Transport, method: str, url: str,
          allow_http_fallback: bool = False,
          stream_to: str | None = None) -> Response:
     """One request with retry/backoff on retryable statuses and network
-    errors, optional https→http downgrade for plain-HTTP registries."""
+    errors, optional https→http downgrade for plain-HTTP registries.
+
+    Every request carries a W3C ``traceparent`` header naming the
+    active build's trace and the innermost open span, so registry and
+    cache-KV server logs correlate with the build's span tree /
+    ``--trace-out`` export. Retries of one logical request reuse the
+    same header — they ARE the same operation."""
     headers = dict(headers or {})
+    headers.setdefault("traceparent", metrics.current_traceparent())
     last: Exception | None = None
     for attempt in range(retries):
         try:

@@ -28,9 +28,12 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import os
 import threading
 import time
 from typing import Any, Iterator
+
+from makisu_tpu.utils import events
 
 _LabelKey = tuple[tuple[str, str], ...]
 
@@ -45,11 +48,22 @@ def _label_key(labels: dict[str, Any]) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def new_id(nbytes: int) -> str:
+    """Random lowercase-hex identifier of ``2 * nbytes`` characters.
+    W3C trace ids are 16 bytes, span ids 8 (trace-context §3.2.2.3-4)."""
+    return os.urandom(nbytes).hex()
+
+
 class Span:
-    """One timed operation; children nest via the context variable."""
+    """One timed operation; children nest via the context variable.
+
+    Every span carries a W3C-shaped 64-bit span id and its parent's, so
+    the tree exports losslessly (Perfetto, the event stream) and the
+    ``traceparent`` header on outbound HTTP names the exact span that
+    issued the request."""
 
     __slots__ = ("name", "attrs", "start_unix", "duration", "error",
-                 "children", "registry", "_t0")
+                 "children", "registry", "span_id", "parent_id", "_t0")
 
     def __init__(self, name: str, attrs: dict[str, Any],
                  registry: "MetricsRegistry") -> None:
@@ -61,14 +75,19 @@ class Span:
         self.error: str | None = None
         self.children: list[Span] = []
         self.registry = registry
+        self.span_id = new_id(8)
+        self.parent_id = ""
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
             "name": self.name,
+            "span_id": self.span_id,
             "start": round(self.start_unix, 6),
             "duration": (round(self.duration, 6)
                          if self.duration is not None else None),
         }
+        if self.parent_id:
+            out["parent_id"] = self.parent_id
         if self.attrs:
             out["attrs"] = dict(self.attrs)
         if self.error:
@@ -110,6 +129,11 @@ class MetricsRegistry:
         self._counters: dict[str, dict[_LabelKey, float]] = {}
         self._gauges: dict[str, dict[_LabelKey, float]] = {}
         self._hists: dict[str, dict[_LabelKey, _Hist]] = {}
+        # One 128-bit trace id per registry: every span in this
+        # registry's tree — and every traceparent header a request in
+        # its context carries — shares it, so a build's outbound HTTP
+        # is correlatable with registry/KV server logs.
+        self.trace_id = new_id(16)
         self.root = Span("root", {}, self)
 
     # -- writes -----------------------------------------------------------
@@ -183,6 +207,7 @@ class MetricsRegistry:
             spans = [c.to_dict() for c in self.root.children]
         return {
             "schema": "makisu-tpu.metrics.v1",
+            "trace_id": self.trace_id,
             "spans": spans,
             "counters": counters,
             "gauges": gauges,
@@ -246,15 +271,20 @@ def observe(name: str, value: float,
 def span(name: str, **attrs: Any) -> Iterator[Span]:
     """Timed scope attached to the innermost bound registry's tree.
     Nested spans become children; exceptions mark the span and
-    propagate (telemetry never swallows a build failure)."""
+    propagate (telemetry never swallows a build failure). Open/close
+    mirror onto the build event bus (no-op unless a sink is bound)."""
     reg = active_registry()
     parent = _current_span.get()
     if parent is None or parent.registry is not reg:
         parent = reg.root
     s = Span(name, attrs, reg)
+    s.parent_id = parent.span_id
     with reg._lock:
         parent.children.append(s)
     token = _current_span.set(s)
+    events.emit("span_start", name=name, span_id=s.span_id,
+                parent_id=s.parent_id,
+                **({"attrs": s.attrs} if s.attrs else {}))
     try:
         yield s
     except BaseException as e:
@@ -263,6 +293,21 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
     finally:
         s.duration = time.monotonic() - s._t0
         _current_span.reset(token)
+        events.emit("span_end", name=name, span_id=s.span_id,
+                    duration=round(s.duration, 6),
+                    **({"error": s.error} if s.error else {}))
+
+
+def current_traceparent() -> str:
+    """W3C ``traceparent`` header value for the innermost open span of
+    the active registry: ``00-<trace-id>-<span-id>-01``. With no span
+    open, the registry's root span id is used — every outbound request
+    is attributable to a trace even outside a build."""
+    reg = active_registry()
+    s = _current_span.get()
+    if s is None or s.registry is not reg:
+        s = reg.root
+    return f"00-{reg.trace_id}-{s.span_id}-01"
 
 
 # -- renderers -------------------------------------------------------------
@@ -358,10 +403,29 @@ def write_report(path: str,
                  **extra: Any) -> None:
     """Write a build's JSON telemetry report (the ``--metrics-out``
     payload): span tree + counters, plus any caller extras (exit code,
-    argv)."""
+    argv). Atomic: tmp file + ``os.replace``, so a build killed
+    mid-write never leaves a torn half-JSON report behind."""
     reg = registry if registry is not None else active_registry()
     payload = reg.report()
     payload.update(extra)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=2, sort_keys=False)
-        f.write("\n")
+    write_json_atomic(path, payload)
+
+
+def write_json_atomic(path: str, payload: Any) -> None:
+    """Atomically serialize ``payload`` as JSON to ``path``. The tmp
+    name carries the pid so concurrent builds writing into one
+    directory can't cross-clobber each other's staging files.
+    ``default=str`` for the same reason the event sinks use it: a
+    non-JSON-native span attr must degrade to its repr, not fail the
+    invocation after the build itself succeeded."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=False,
+                      default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

@@ -40,6 +40,9 @@ class WorkerClient:
         # Terminal payload of the last build() call: exit_code and
         # elapsed_seconds as data, no log-text parsing needed.
         self.last_build: dict = {}
+        # Build events (span open/close, steps, cache outcomes) streamed
+        # by the last build() call, in arrival order.
+        self.last_events: list[dict] = []
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         conn = _UnixHTTPConnection(self.socket_path, self.timeout)
@@ -91,16 +94,34 @@ class WorkerClient:
         return os.path.join(self.worker_shared_path or
                             self.local_shared_path, name)
 
+    def healthz(self) -> dict:
+        """The worker's ``GET /healthz`` payload: uptime plus builds
+        started/succeeded/failed/active."""
+        conn, resp = self._request("GET", "/healthz")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /healthz returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
     def build(self, argv: list[str],
               context_dir: str | None = None,
-              on_line=None) -> int:
+              on_line=None, on_event=None) -> int:
         """Submit a build; stream log lines to the local logger (and
         ``on_line(payload)`` when given); return the worker's build exit
-        code."""
+        code.
+
+        The response stream carries three frame types, all NDJSON:
+        log lines, build events (``{"event": {...}}`` — collected into
+        ``last_events`` and forwarded to ``on_event`` when given), and
+        the terminal outcome (``{"build_code": ...}``)."""
         if context_dir is not None:
             worker_ctx = self.prepare_context(context_dir)
             argv = list(argv) + [worker_ctx]
         self.last_build = {}  # stale outcome must not survive a retry
+        self.last_events = []
         conn, resp = self._request("POST", "/build",
                                    json.dumps(argv).encode())
         build_code = 1
@@ -126,6 +147,10 @@ class WorkerClient:
                     if "build_code" in payload:
                         build_code = int(payload["build_code"])
                         self.last_build = payload
+                    elif "event" in payload:
+                        self.last_events.append(payload["event"])
+                        if on_event is not None:
+                            on_event(payload["event"])
                     else:
                         if on_line is not None:
                             on_line(payload)

@@ -3,8 +3,11 @@
 Protocol (reference: lib/client/client.go):
 - GET  /ready  → 200 when accepting builds
 - POST /build  → body is a JSON argv list for the build command; the
-  response streams newline-delimited JSON log lines and ends with
-  ``{"build_code": "<exit code>"}``
+  response streams newline-delimited JSON frames — log lines, build
+  events (``{"event": {...}}``), and the terminal
+  ``{"build_code": "<exit code>", ...}``
+- GET  /metrics → Prometheus text of the process-global registry
+- GET  /healthz → uptime + builds started/succeeded/failed/active
 - GET  /exit   → 200, then the server shuts down
 """
 
@@ -39,6 +42,12 @@ class _Handler(BaseHTTPRequestHandler):
             from makisu_tpu.utils import metrics
             self._respond(200, metrics.render_prometheus().encode(),
                           content_type=_METRICS_CONTENT_TYPE)
+        elif self.path == "/healthz":
+            # Liveness + vital signs as JSON: what a k8s probe or a
+            # dashboard polls without parsing Prometheus text.
+            self._respond(200,
+                          json.dumps(self.server.health()).encode(),
+                          content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -170,6 +179,13 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
         self.socket_path = socket_path
+        # /healthz vital signs. Monotonic for uptime (wall clock can
+        # step); counters under one lock, cheap enough per build.
+        self._started_mono = time.monotonic()
+        self._health_mu = threading.Lock()
+        self._builds_started = 0
+        self._builds_succeeded = 0
+        self._builds_failed = 0
         # Builds from all connections share one process — and therefore
         # one HashService, so chunk hashing from concurrent builds
         # batches onto full device programs (the build-farm scenario).
@@ -203,12 +219,17 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         return request, ("worker", 0)
 
     def run_build(self, argv: list[str], emit) -> int:
-        """Run one build command in-process, forwarding log lines.
+        """Run one build command in-process, forwarding log lines and
+        build events.
 
-        The emit sink binds to this request's context (and the threads
-        the build spawns), so concurrent builds' streams stay separate —
-        client A never sees client B's log lines."""
+        The log sink and event sink bind to this request's context (and
+        the threads the build spawns), so concurrent builds' streams
+        stay separate — client A never sees client B's log lines or
+        events. Events ride the same chunked NDJSON stream as their own
+        frame type, ``{"event": {...}}``, so a client watches the
+        build's structure (spans, steps, cache outcomes) live."""
         from makisu_tpu import cli
+        from makisu_tpu.utils import events, metrics
         from makisu_tpu.utils import logging as log
 
         def sink(level: str, msg: str, fields: dict) -> None:
@@ -217,10 +238,31 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             except OSError:
                 pass  # client went away; keep building
 
+        def event_sink(event: dict) -> None:
+            try:
+                emit(json.dumps({"event": event}, default=str))
+            except OSError:
+                pass  # client went away; keep building
+
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
         level = _effective_flags(argv)["log_level"]
         token = log.set_build_sink(sink, level.replace("warn", "warning"))
+        events_token = events.add_sink(event_sink)
+        mode_token = cli.invocation_mode.set("worker")
+        # Count the build started BEFORE acquiring shared-path locks:
+        # a build wedged waiting on another build's --root/--storage
+        # must show as active in /healthz — that is the situation the
+        # endpoint exists to expose. Gauge writes stay under
+        # _health_mu: set outside the lock, two builds finishing
+        # together could publish counts out of order and wedge the
+        # gauge at a stale nonzero value.
+        with self._health_mu:
+            self._builds_started += 1
+            metrics.global_registry().gauge_set(
+                "makisu_worker_active_builds",
+                self._builds_started - self._builds_succeeded
+                - self._builds_failed)
         locks = self._shared_path_locks(argv)
         for lock in locks:
             lock.acquire()
@@ -229,18 +271,52 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             code = cli.main(argv)
             return code
         except SystemExit as e:
-            code = int(e.code or 0)
+            # argparse exits with an int; cmd_report exits with a
+            # message string (exit status 1, message to the client).
+            if e.code is None or isinstance(e.code, int):
+                code = e.code or 0
+            else:
+                emit(json.dumps({"level": "error", "msg": str(e.code)}))
+                code = 1
             return code
         except Exception as e:  # noqa: BLE001 - worker must survive
             emit(json.dumps({"level": "error", "msg": str(e)}))
             return 1
         finally:
-            from makisu_tpu.utils import metrics
             metrics.counter_add("makisu_worker_builds_total",
                                 result="ok" if code == 0 else "error")
+            with self._health_mu:
+                if code == 0:
+                    self._builds_succeeded += 1
+                else:
+                    self._builds_failed += 1
+                metrics.global_registry().gauge_set(
+                    "makisu_worker_active_builds",
+                    self._builds_started - self._builds_succeeded
+                    - self._builds_failed)
             for lock in reversed(locks):
                 lock.release()
+            cli.invocation_mode.reset(mode_token)
+            events.reset_sink(events_token)
             log.reset_build_sink(token)
+
+    def health(self) -> dict:
+        """The ``GET /healthz`` payload: uptime and build outcome
+        counts (active = started - finished; a build blocked on a
+        shared --root/--storage path lock counts as active)."""
+        with self._health_mu:
+            started = self._builds_started
+            succeeded = self._builds_succeeded
+            failed = self._builds_failed
+        return {
+            "status": "ok",
+            "uptime_seconds": round(
+                time.monotonic() - self._started_mono, 3),
+            "builds_started": started,
+            "builds_succeeded": succeeded,
+            "builds_failed": failed,
+            "active_builds": started - succeeded - failed,
+        }
 
     def _shared_path_locks(self, argv: list[str]) -> list:
         """Locks for this build's --root/--storage dirs (created on

@@ -1,0 +1,121 @@
+"""Build event bus: sink scoping, JSONL round-trip, failure isolation."""
+
+import contextvars
+import json
+import threading
+
+import pytest
+
+from makisu_tpu.utils import events
+
+
+def test_emit_without_sink_is_noop():
+    # Must simply not raise — instrumentation sites run unconditionally.
+    events.emit("anything", value=1)
+    assert not events.active()
+
+
+def test_sink_receives_typed_timestamped_events():
+    seen = []
+    token = events.add_sink(seen.append)
+    try:
+        assert events.active()
+        events.emit("cache", result="hit", cache_id="abc")
+    finally:
+        events.reset_sink(token)
+    [event] = seen
+    assert event["type"] == "cache"
+    assert event["result"] == "hit"
+    assert event["cache_id"] == "abc"
+    assert isinstance(event["ts"], float)
+
+
+def test_sinks_stack_and_raising_sink_is_swallowed():
+    seen = []
+
+    def bad_sink(event):
+        raise RuntimeError("dead sink")
+
+    t1 = events.add_sink(bad_sink)
+    t2 = events.add_sink(seen.append)
+    try:
+        events.emit("step", phase="start")
+    finally:
+        events.reset_sink(t2)
+        events.reset_sink(t1)
+    assert len(seen) == 1
+
+
+def test_sink_is_context_scoped():
+    """A sink bound in one context must be invisible to a bare thread
+    (no copy_context) — the isolation that keeps concurrent worker
+    builds' event streams separate."""
+    seen = []
+    leaked = []
+
+    def probe():
+        events.emit("leak_probe")
+
+    token = events.add_sink(seen.append)
+    try:
+        bare = threading.Thread(target=probe)
+        bare.start()
+        bare.join()
+        leaked = list(seen)
+        # A thread that DOES carry the context delivers.
+        carried = threading.Thread(
+            target=contextvars.copy_context().run, args=(probe,))
+        carried.start()
+        carried.join()
+    finally:
+        events.reset_sink(token)
+    assert leaked == []
+    assert [e["type"] for e in seen] == ["leak_probe"]
+
+
+def test_jsonl_writer_round_trip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    writer = events.JsonlWriter(path)
+    token = events.add_sink(writer)
+    try:
+        events.emit("build_start", command="build")
+        events.emit("span_start", name="stage", span_id="ab" * 8)
+        events.emit("build_end", exit_code=0)
+    finally:
+        events.reset_sink(token)
+        writer.close()
+    log = events.read_jsonl(path)
+    assert [e["type"] for e in log] == \
+        ["build_start", "span_start", "build_end"]
+    # One event per line, compact separators, no trailing garbage.
+    lines = (tmp_path / "events.jsonl").read_text().splitlines()
+    assert len(lines) == 3
+    assert all(json.loads(line) for line in lines)
+
+
+def test_jsonl_writer_after_close_is_noop(tmp_path):
+    writer = events.JsonlWriter(str(tmp_path / "e.jsonl"))
+    writer.close()
+    writer({"type": "late"})  # must not raise on the closed file
+    assert (tmp_path / "e.jsonl").read_text() == ""
+
+
+def test_read_jsonl_names_truncated_line(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text('{"ts": 1, "type": "ok"}\n{"ts": 2, "ty')
+    with pytest.raises(ValueError, match=r"torn\.jsonl:2"):
+        events.read_jsonl(str(path))
+
+
+def test_read_jsonl_skips_blank_lines(tmp_path):
+    path = tmp_path / "gaps.jsonl"
+    path.write_text('{"type": "a"}\n\n{"type": "b"}\n')
+    assert [e["type"] for e in events.read_jsonl(str(path))] == ["a", "b"]
+
+
+def test_read_jsonl_skip_invalid_salvages_prefix(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text('{"type": "a"}\nnot json\n{"type": "b"}\n{"ty')
+    assert [e["type"]
+            for e in events.read_jsonl(str(path), skip_invalid=True)] \
+        == ["a", "b"]

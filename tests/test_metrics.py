@@ -279,3 +279,26 @@ def test_concurrent_builds_have_isolated_telemetry(tmp_path, worker):
 
     assert commits(reports[0]) == 2
     assert commits(reports[1]) == 1
+
+
+def test_write_report_atomic_with_extras(tmp_path):
+    """write_report lands complete JSON (tmp + os.replace) including
+    caller extras, and stringifies non-JSON-native span attrs instead
+    of failing the invocation."""
+    import os
+
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        with metrics.span("build", where=tmp_path):  # Path attr
+            metrics.counter_add("makisu_layer_commits_total")
+    finally:
+        metrics.reset_build_registry(token)
+    out = tmp_path / "report.json"
+    metrics.write_report(str(out), reg, command="build", exit_code=0)
+    report = json.loads(out.read_text())
+    assert report["command"] == "build"
+    assert report["exit_code"] == 0
+    assert report["spans"][0]["attrs"]["where"] == str(tmp_path)
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("report.json.tmp.")]

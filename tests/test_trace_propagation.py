@@ -1,0 +1,212 @@
+"""Trace propagation: every outbound HTTP request a build issues —
+registry plane and cache-KV plane — must carry a W3C ``traceparent``
+header whose trace id is the build's own, so server-side access logs
+correlate with the build's span tree and trace export."""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from makisu_tpu import cli
+from makisu_tpu.cache.kv import HTTPStore
+from makisu_tpu.tools.miniregistry import MiniRegistry
+from makisu_tpu.utils import httputil, metrics
+
+TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-01$")
+
+
+def trace_id_of(header: str) -> str:
+    match = TRACEPARENT_RE.match(header)
+    assert match, f"malformed traceparent {header!r}"
+    return match.group(1)
+
+
+# -- unit: header shape and injection point --------------------------------
+
+
+def test_current_traceparent_is_w3c_shaped():
+    assert TRACEPARENT_RE.match(metrics.current_traceparent())
+
+
+def test_traceparent_names_bound_registry_and_open_span():
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        with metrics.span("outer") as s:
+            header = metrics.current_traceparent()
+            assert trace_id_of(header) == reg.trace_id
+            assert header.split("-")[2] == s.span_id
+        # No open span: falls back to the registry's root span.
+        assert metrics.current_traceparent().split("-")[2] == \
+            reg.root.span_id
+    finally:
+        metrics.reset_build_registry(token)
+
+
+class _RecordingTransport(httputil.Transport):
+    def __init__(self) -> None:
+        super().__init__()
+        self.seen: list[dict] = []
+
+    def round_trip(self, method, url, headers, body=None, timeout=60.0,
+                   stream_to=None):
+        self.seen.append(dict(headers))
+        return httputil.Response(200, {}, b"ok")
+
+
+def test_send_injects_traceparent():
+    transport = _RecordingTransport()
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        httputil.send(transport, "GET", "http://example/x")
+    finally:
+        metrics.reset_build_registry(token)
+    [headers] = transport.seen
+    assert trace_id_of(headers["traceparent"]) == reg.trace_id
+
+
+def test_send_keeps_caller_traceparent():
+    """An explicitly provided traceparent (a caller continuing an
+    upstream trace) must not be clobbered."""
+    transport = _RecordingTransport()
+    upstream = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    httputil.send(transport, "GET", "http://example/x",
+                  headers={"traceparent": upstream})
+    assert transport.seen[0]["traceparent"] == upstream
+
+
+# -- cache-KV plane --------------------------------------------------------
+
+
+class _RecordingKVServer:
+    """Tiny HTTP KV store recording the traceparent of each request."""
+
+    def __init__(self) -> None:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _record(self):
+                with outer.lock:
+                    outer.requests.append(
+                        (self.command, self.path,
+                         self.headers.get("traceparent", "")))
+
+            def do_GET(self):
+                self._record()
+                with outer.lock:
+                    value = outer.data.get(self.path)
+                if value is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(value)))
+                self.end_headers()
+                self.wfile.write(value)
+
+            def do_PUT(self):
+                self._record()
+                n = int(self.headers.get("Content-Length") or 0)
+                with outer.lock:
+                    outer.data[self.path] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.data: dict[str, bytes] = {}
+        self.requests: list[tuple[str, str, str]] = []
+        self.lock = threading.Lock()
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def addr(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=10)
+
+
+@pytest.fixture
+def kv_server():
+    server = _RecordingKVServer()
+    yield server
+    server.stop()
+
+
+def test_http_kv_store_carries_traceparent(kv_server):
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        store = HTTPStore(kv_server.addr)
+        store.put("k1", "v1")
+        assert store.get("k1") == "v1"
+    finally:
+        metrics.reset_build_registry(token)
+    assert len(kv_server.requests) == 2
+    for _method, _path, header in kv_server.requests:
+        assert trace_id_of(header) == reg.trace_id
+
+
+def test_http_kv_store_configured_headers_win(kv_server):
+    store = HTTPStore(kv_server.addr,
+                      headers={"traceparent": "pinned-by-operator"})
+    store.put("k2", "v2")
+    assert kv_server.requests[-1][2] == "pinned-by-operator"
+
+
+# -- end-to-end: a real build against the in-repo miniregistry -------------
+
+
+def test_build_requests_carry_build_trace_id(tmp_path, kv_server):
+    """A tiny build that pushes to the miniregistry and uses an HTTP
+    cache KV: EVERY registry request and EVERY KV request must carry a
+    traceparent whose trace id equals the build's root trace id (as
+    written to the --metrics-out report)."""
+    ctx = tmp_path / "ctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY data.txt /data.txt\n")
+    (ctx / "data.txt").write_text("trace propagation payload\n" * 32)
+    (tmp_path / "root").mkdir()
+    report_path = tmp_path / "report.json"
+
+    with MiniRegistry() as registry:
+        code = cli.main([
+            "--metrics-out", str(report_path),
+            "build", str(ctx), "-t", "trace/prop:1",
+            "--push", registry.addr,
+            "--http-cache-addr", kv_server.addr,
+            "--storage", str(tmp_path / "storage"),
+            "--root", str(tmp_path / "root"),
+        ])
+        assert code == 0
+        registry_requests = list(registry.state.requests)
+
+    report = json.loads(report_path.read_text())
+    trace_id = report["trace_id"]
+    assert re.fullmatch(r"[0-9a-f]{32}", trace_id)
+
+    assert registry_requests, "build issued no registry requests?"
+    for method, path, header in registry_requests:
+        assert trace_id_of(header) == trace_id, \
+            f"{method} {path} carried foreign/absent trace {header!r}"
+
+    assert kv_server.requests, "build issued no cache-KV requests?"
+    for method, path, header in kv_server.requests:
+        assert trace_id_of(header) == trace_id, \
+            f"KV {method} {path} carried foreign/absent trace {header!r}"

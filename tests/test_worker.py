@@ -265,3 +265,129 @@ def test_pull_through_worker_with_per_request_config(tmp_path, worker):
         assert after == before
     finally:
         set_transport_factory(None)
+
+
+def test_build_streams_event_frames(tmp_path, worker):
+    """NDJSON event framing round-trip: events emitted inside the
+    worker's build ride the /build response stream as their own frame
+    type and arrive as dicts — collected into ``last_events`` and
+    forwarded to ``on_event`` in order."""
+    ctx = tmp_path / "ectx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY data.txt /data.txt\n")
+    (ctx / "data.txt").write_text("event frame payload")
+    (tmp_path / "eroot").mkdir()
+    client = WorkerClient(worker.socket_path)
+    streamed = []
+    code = client.build([
+        "--metrics-out", str(tmp_path / "ereport.json"),
+        "build", str(ctx), "-t", "worker/events:1",
+        "--storage", str(tmp_path / "estorage"),
+        "--root", str(tmp_path / "eroot"),
+        "--dest", str(tmp_path / "eout.tar"),
+    ], on_event=streamed.append)
+    assert code == 0
+    # In-worker builds label their build_info gauge mode="worker"
+    # (context-scoped — no process-env mutation).
+    import json as json_mod
+    report = json_mod.loads((tmp_path / "ereport.json").read_text())
+    [info] = report["gauges"]["makisu_build_info"]
+    assert info["labels"]["mode"] == "worker"
+    assert client.last_events == streamed
+    types = [e["type"] for e in streamed]
+    assert types[0] == "build_start"
+    assert types[-1] == "build_end"
+    assert "span_start" in types and "span_end" in types
+    assert "step" in types
+    # Every frame survived JSON round-trip as a timestamped dict.
+    assert all(isinstance(e["ts"], float) for e in streamed)
+    # span_start/span_end pair up by span id.
+    opened = [e["span_id"] for e in streamed if e["type"] == "span_start"]
+    closed = [e["span_id"] for e in streamed if e["type"] == "span_end"]
+    assert sorted(opened) == sorted(closed)
+
+
+def test_concurrent_builds_do_not_mix_event_streams(tmp_path, worker):
+    """Client A's event frames must never surface in client B's stream
+    (the same isolation guarantee the log sinks give)."""
+    import threading
+
+    streams = {}
+
+    def one(i):
+        ctx = tmp_path / f"evctx{i}"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text(
+            "FROM scratch\nCOPY d.txt /d.txt\n")
+        (ctx / "d.txt").write_text(f"payload-{i}" * 8)
+        (tmp_path / f"evroot{i}").mkdir()
+        client = WorkerClient(worker.socket_path)
+        events = []
+        code = client.build([
+            "build", str(ctx), "-t", f"worker/ev{i}:1",
+            "--storage", str(tmp_path / f"evstorage{i}"),
+            "--root", str(tmp_path / f"evroot{i}"),
+            "--dest", str(tmp_path / f"evout{i}.tar"),
+        ], on_event=events.append)
+        streams[i] = (code, events)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    trace_ids = {}
+    for i in (0, 1):
+        code, events = streams[i]
+        assert code == 0
+        assert events, f"build {i} streamed no events"
+        starts = [e for e in events if e["type"] == "build_start"]
+        assert len(starts) == 1, "exactly one build_start per stream"
+        trace_ids[i] = starts[0]["trace_id"]
+    assert trace_ids[0] != trace_ids[1]
+
+
+def test_healthz(tmp_path, worker):
+    client = WorkerClient(worker.socket_path)
+    before = client.healthz()
+    assert before["status"] == "ok"
+    assert before["uptime_seconds"] >= 0
+    assert before["active_builds"] == 0
+
+    ctx = tmp_path / "hctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text("FROM scratch\nCOPY h /h\n")
+    (ctx / "h").write_text("x")
+    (tmp_path / "hroot").mkdir()
+    ok = client.build(["build", str(ctx), "-t", "worker/h:1",
+                       "--storage", str(tmp_path / "hstorage"),
+                       "--root", str(tmp_path / "hroot"),
+                       "--dest", str(tmp_path / "hout.tar")])
+    assert ok == 0
+    bad = client.build(["build", "/nonexistent", "-t", "x:y",
+                        "--storage", str(tmp_path / "hs2"),
+                        "--root", str(tmp_path / "hr2")])
+    assert bad == 1
+
+    after = client.healthz()
+    assert after["builds_started"] == before["builds_started"] + 2
+    assert after["builds_succeeded"] == before["builds_succeeded"] + 1
+    assert after["builds_failed"] == before["builds_failed"] + 1
+    assert after["active_builds"] == 0
+    assert after["uptime_seconds"] >= before["uptime_seconds"]
+
+
+def test_worker_survives_systemexit_with_message(tmp_path, worker):
+    """cmd_report raises SystemExit with a STRING (schema mismatch);
+    the worker must map it to exit code 1 and keep serving — not die
+    mid-stream on int(<message>)."""
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text('{"hello": "world"}')
+    client = WorkerClient(worker.socket_path)
+    lines = []
+    code = client.build(["report", str(bogus)], on_line=lines.append)
+    assert code == 1
+    assert any("not a makisu-tpu metrics report" in p.get("msg", "")
+               for p in lines)
+    assert client.ready()  # handler thread survived
