@@ -24,8 +24,8 @@ import json
 
 from makisu_tpu import tario
 from makisu_tpu.docker.image import Digest, DigestPair
+from makisu_tpu.registry import transfer
 from makisu_tpu.storage.cas import CASStore
-from makisu_tpu.utils import concurrency
 from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
@@ -384,9 +384,10 @@ class ChunkStore:
                 # layer). Their pack is gone/corrupt — report
                 # unavailable so the pull degrades to the blob route.
                 return False
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(8) as pool:
-            ok = concurrency.ctx_map(pool, self._fetch_remote, missing)
+        # The shared transfer engine bounds these alongside every other
+        # wire path (they used to ride their own ThreadPoolExecutor(8),
+        # unbounded against concurrent builds' transfers).
+        ok = transfer.engine().map(self._fetch_remote, missing)
         metrics.counter_add("makisu_chunks_fetched_total", sum(ok),
                             route="blob")
         events.emit("chunk_fetch", route="blob", fetched=sum(ok),
@@ -489,8 +490,8 @@ class ChunkStore:
 
         requests_issued: list[int] = []  # list.append is GIL-atomic
         if run_jobs:
-            from concurrent.futures import ThreadPoolExecutor
             range_failed: set[str] = set()
+            budget = transfer.engine().budget
 
             def fetch_pack_runs(job) -> None:
                 # One task per PACK; its runs issue sequentially so a
@@ -502,21 +503,33 @@ class ChunkStore:
                 for run in runs:
                     start = run[0][0]
                     end = run[-1][0] + run[-1][1]
-                    got_range = self.registry.pull_blob_range(
-                        Digest.from_hex(pack_hex), start, end)
-                    requests_issued.append(1)
-                    if got_range is None:
-                        range_failed.add(pack_hex)  # whole-pack later
-                        return
-                    kind, data = got_range
-                    if kind == "partial":
-                        carve(pack_hex, data, start, run)
-                    else:  # whole blob in hand: finish the pack here
-                        carve(pack_hex, data, 0, pack_spans[pack_hex])
+                    # A run's bytes materialize in memory until carved
+                    # into the CAS; charge them against the global
+                    # transfer budget.
+                    with budget.reserve(end - start):
+                        got_range = self.registry.pull_blob_range(
+                            Digest.from_hex(pack_hex), start, end)
+                        requests_issued.append(1)
+                        if got_range is None:
+                            range_failed.add(pack_hex)  # whole-pack later
+                            return
+                        kind, data = got_range
+                        if kind == "partial":
+                            carve(pack_hex, data, start, run)
+                    if kind == "full":
+                        # The server ignored Range and the WHOLE pack
+                        # is in hand. Re-reserve at its true size —
+                        # outside the run reservation, or a self-held
+                        # budget could never be satisfied — so
+                        # concurrent pack jobs against a Range-less
+                        # registry throttle at their real footprint,
+                        # then finish the pack here.
+                        with budget.reserve(len(data)):
+                            carve(pack_hex, data, 0,
+                                  pack_spans[pack_hex])
                         return
 
-            with ThreadPoolExecutor(8) as pool:
-                concurrency.ctx_map(pool, fetch_pack_runs, run_jobs)
+            transfer.engine().map(fetch_pack_runs, run_jobs)
             whole_jobs.extend(sorted(range_failed))
         n_requests = len(requests_issued)
 
@@ -799,9 +812,9 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                         log.warning("pack push for %s failed; falling "
                                     "back to per-chunk blobs", cache_id)
                     # Per-chunk route (packs disabled or failed): one
-                    # blob per chunk, uploaded on a pool since per-blob
-                    # round trips, not bytes, dominate.
-                    from concurrent.futures import ThreadPoolExecutor
+                    # blob per chunk, uploaded via the shared transfer
+                    # engine since per-blob round trips, not bytes,
+                    # dominate.
                     failed = []
 
                     def push_one(hex_digest):
@@ -810,8 +823,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                         except Exception as e:  # noqa: BLE001
                             failed.append((hex_digest, e))
 
-                    with ThreadPoolExecutor(8) as pool:
-                        concurrency.ctx_map(pool, push_one, added)
+                    transfer.engine().map(push_one, added)
                     if failed:
                         log.warning("chunk push failed for %d/%d "
                                     "chunks (first: %s: %s)",

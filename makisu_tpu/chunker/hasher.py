@@ -112,7 +112,13 @@ class LayerSink:
             raise RuntimeError("layer compression failed") \
                 from self._worker_error[0]
         if self._queue is not None:
-            self._put_checked(bytes(data))
+            # The queue hands data to the compressor thread AFTER this
+            # call returns, so a mutable buffer (bytearray, memoryview
+            # a tar writer recycles) must be copied — but immutable
+            # bytes, the overwhelmingly common case, can be enqueued
+            # as-is: a per-write copy on the layer hot path.
+            self._put_checked(data if isinstance(data, bytes)
+                              else bytes(data))
         self._tar_digest.update(data)
         self._nbytes += len(data)
         if self._queue is None:

@@ -1,6 +1,7 @@
 """Registry v2 client (reference: lib/registry/)."""
 
-from makisu_tpu.registry.client import RegistryClient, new_client
+from makisu_tpu.registry import transfer
+from makisu_tpu.registry.client import PullHandle, RegistryClient, new_client
 from makisu_tpu.registry.config import (
     RegistryConfig,
     SecurityConfig,
@@ -12,7 +13,7 @@ from makisu_tpu.registry.config import (
 from makisu_tpu.registry.fixtures import RegistryFixture, make_test_image
 
 __all__ = [
-    "RegistryClient", "RegistryConfig", "RegistryFixture", "SecurityConfig",
-    "config_for", "make_test_image", "new_client", "reset_global_config",
-    "update_global_config",
+    "PullHandle", "RegistryClient", "RegistryConfig", "RegistryFixture",
+    "SecurityConfig", "config_for", "make_test_image", "new_client",
+    "reset_global_config", "transfer", "update_global_config",
 ]

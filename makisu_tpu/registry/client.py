@@ -16,7 +16,6 @@ import os
 import re
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 from makisu_tpu.docker.image import (  # noqa: F401 - re-export surface
     MEDIA_TYPE_MANIFEST_LIST,
@@ -30,9 +29,9 @@ from makisu_tpu.docker.image import (  # noqa: F401 - re-export surface
     DistributionManifest,
     ImageName,
 )
+from makisu_tpu.registry import transfer
 from makisu_tpu.registry.config import RegistryConfig, config_for
 from makisu_tpu.storage import ImageStore
-from makisu_tpu.utils import concurrency
 from makisu_tpu.utils import events
 from makisu_tpu.utils import httputil
 from makisu_tpu.utils import logging as log
@@ -243,18 +242,40 @@ class RegistryClient:
 
     def pull(self, name: ImageName | str) -> DistributionManifest:
         """Pull manifest + config + all layers into the local store."""
+        start = time.time()
+        handle = self.start_pull(name)
+        handle.wait_all()
+        log.info("pulled %s/%s:%s", self.registry, self.repository,
+                 handle.tag, duration=time.time() - start)
+        return handle.manifest
+
+    def start_pull(self, name: ImageName | str) -> "PullHandle":
+        """Begin a pipelined pull: the manifest and config blob
+        transfer synchronously (callers need both immediately); layer
+        blobs download ahead on the shared transfer engine. The
+        returned handle waits per layer — FROM application extracts
+        layer k while layers k+1.. are still on the wire — or for
+        everything (``wait_all``, which also saves the manifest under
+        the image name, completing what ``pull`` promises)."""
         tag = name.tag if isinstance(name, ImageName) else str(name)
         manifest = self.pull_manifest(tag)
-        digests = {manifest.config.digest}
-        digests.update(manifest.layer_digests())
-        start = time.time()
-        with ThreadPoolExecutor(self.config.concurrency) as pool:
-            concurrency.ctx_map(pool, self.pull_layer, digests)
-        log.info("pulled %s/%s:%s", self.registry, self.repository, tag,
-                 duration=time.time() - start)
-        if isinstance(name, ImageName):
-            self.store.manifests.save(name, manifest)
-        return manifest
+        self.pull_layer(manifest.config.digest,
+                        size=manifest.config.size)
+        eng = transfer.engine()
+        futures = {}
+        for desc in manifest.layers:
+            hex_digest = desc.digest.hex()
+            if hex_digest in futures:
+                continue  # one transfer per digest, however often it repeats
+            futures[hex_digest] = eng.submit(
+                self._transfer_pull, desc.digest, desc.size)
+        return PullHandle(self, name if isinstance(name, ImageName)
+                          else None, tag, manifest, futures)
+
+    def _transfer_pull(self, digest: Digest, size: int) -> str:
+        with metrics.span("transfer", op="pull",
+                          digest=Digest(digest).hex()[:12], bytes=size):
+            return self.pull_layer(digest, size=size)
 
     def pull_manifest(self, tag: str,
                       _depth: int = 0) -> DistributionManifest:
@@ -374,15 +395,19 @@ class RegistryClient:
             layers=[fix(l, MEDIA_TYPE_OCI_LAYER, MEDIA_TYPE_LAYER)
                     for l in manifest.layers])
 
-    def pull_layer(self, digest: Digest) -> str:
+    def pull_layer(self, digest: Digest, size: int = 0) -> str:
         """Download one blob into the CAS store (no-op if present).
 
-        The body streams to a sandbox file in 1MiB chunks — layer blobs
-        can be multi-GB (reference pullLayerHelper:301-362 also streams
-        to a download file before committing to the CAS). The downloaded
-        bytes are verified against the requested digest before the CAS
-        link (reference client.go:288-289, saveLayer verify :620-627) —
-        a corrupt/truncated/tampered response must never be stored under
+        A blob whose known ``size`` crosses the transfer engine's split
+        threshold downloads as concurrent HTTP Range parts reassembled
+        at-offset (falling back to one streamed GET when the server
+        ignores Range); everything else streams to a sandbox file in
+        1MiB chunks — layer blobs can be multi-GB (reference
+        pullLayerHelper:301-362 also streams to a download file before
+        committing to the CAS). Either way the downloaded bytes are
+        verified against the requested digest before the CAS link
+        (reference client.go:288-289, saveLayer verify :620-627) — a
+        corrupt/truncated/tampered response must never be stored under
         a trusted digest name."""
         import tempfile
         hex_digest = Digest(digest).hex()
@@ -391,25 +416,39 @@ class RegistryClient:
         fd, tmp = tempfile.mkstemp(prefix="blob-")
         os.close(fd)
         try:
-            resp = self._get_blob_following_redirects(
-                digest, accepted=(200,), stream_to=tmp)
-            if resp.status == 200 and resp.body:
-                # Transport without streaming support (fixtures).
-                with open(tmp, "wb") as f:
-                    f.write(resp.body)
-            # Prefer the hash computed while the bytes streamed in; only
-            # non-streaming transports cost a re-read of tmp.
-            if resp.stream_sha256:
-                actual = resp.stream_sha256
-            elif resp.body:
-                import hashlib
-                actual = hashlib.sha256(resp.body).hexdigest()
-            else:
-                actual = _sha256_file(tmp)
+            actual = None
+            eng = transfer.engine()
+            if size and eng.should_split(size):
+                actual = eng.pull_blob_parts(self, digest, size, tmp)
+            # Ranged parts already counted their bytes per request in
+            # _ranged_blob_get; only the streaming route's bytes are
+            # uncounted so far.
+            streamed = actual is None
+            if actual is None:
+                # The streaming route's resident footprint is one read
+                # buffer; reserve that, not the blob.
+                with eng.budget.reserve(transfer.STREAM_RESERVE):
+                    resp = self._get_blob_following_redirects(
+                        digest, accepted=(200,), stream_to=tmp)
+                if resp.status == 200 and resp.body:
+                    # Transport without streaming support (fixtures).
+                    with open(tmp, "wb") as f:
+                        f.write(resp.body)
+                # Prefer the hash computed while the bytes streamed in;
+                # only non-streaming transports cost a re-read of tmp.
+                if resp.stream_sha256:
+                    actual = resp.stream_sha256
+                elif resp.body:
+                    import hashlib
+                    actual = hashlib.sha256(resp.body).hexdigest()
+                else:
+                    actual = _sha256_file(tmp)
             # Bytes crossed the wire whether or not the digest checks
             # out — count before the mismatch raise.
-            metrics.counter_add("makisu_registry_bytes_total",
-                                os.path.getsize(tmp), direction="pull")
+            if streamed:
+                metrics.counter_add("makisu_registry_bytes_total",
+                                    os.path.getsize(tmp),
+                                    direction="pull")
             if actual != hex_digest:
                 raise ValueError(
                     f"pulled blob digest mismatch for {digest}: "
@@ -476,6 +515,34 @@ class RegistryClient:
                             accepted=accepted + redirects)
         return resp
 
+    def _ranged_blob_get(self, digest: Digest, start: int, end: int,
+                         stream_to: str | None) -> Response | None:
+        """THE Range-GET core shared by the in-memory and streaming
+        variants so the protocol logic can't drift: redirect-chased GET
+        with a Range header, transfer-byte accounting, and 206 length
+        validation. Returns the Response (status 200 or 206) or None
+        on failure/truncation."""
+        try:
+            resp = self._get_blob_following_redirects(
+                digest, accepted=(200, 206),
+                headers={"Range": f"bytes={start}-{end - 1}"},
+                stream_to=stream_to)
+        except Exception as e:  # noqa: BLE001 - range is an optimization
+            log.debug("ranged blob GET %s [%d,%d) failed: %s", digest,
+                      start, end, e)
+            return None
+        nbytes = len(resp.body)
+        if not resp.body and stream_to is not None:
+            nbytes = os.path.getsize(stream_to)
+        # Count before the length check: truncated bodies still
+        # crossed the wire, and failure episodes are exactly when
+        # transfer volume matters.
+        metrics.counter_add("makisu_registry_bytes_total", nbytes,
+                            direction="pull")
+        if resp.status == 206 and nbytes != end - start:
+            return None
+        return resp
+
     def pull_blob_range(self, digest: Digest, start: int,
                         end: int) -> tuple[str, bytes] | None:
         """GET bytes [start, end) of a blob via an HTTP Range request
@@ -488,24 +555,32 @@ class RegistryClient:
         correctness. No CAS involvement: a range has no digest of its
         own to verify, so callers MUST verify whatever they carve out
         against content digests before storing it (chunks.py does)."""
-        try:
-            resp = self._get_blob_following_redirects(
-                digest, accepted=(200, 206),
-                headers={"Range": f"bytes={start}-{end - 1}"})
-            # Count before the length check: truncated bodies still
-            # crossed the wire, and failure episodes are exactly when
-            # transfer volume matters.
-            metrics.counter_add("makisu_registry_bytes_total",
-                                len(resp.body), direction="pull")
-            if resp.status == 206:
-                if len(resp.body) != end - start:
-                    return None
-                return "partial", resp.body
-            return "full", resp.body
-        except Exception as e:  # noqa: BLE001 - range is an optimization
-            log.debug("ranged blob GET %s [%d,%d) failed: %s", digest,
-                      start, end, e)
+        resp = self._ranged_blob_get(digest, start, end, stream_to=None)
+        if resp is None:
             return None
+        return ("partial" if resp.status == 206 else "full"), resp.body
+
+    def pull_blob_range_to_file(self, digest: Digest, start: int,
+                                end: int, path: str):
+        """Streaming sibling of :meth:`pull_blob_range`, used for the
+        transfer engine's probe part: the 206 range bytes — or the
+        WHOLE blob, when the server ignored Range and answered 200 —
+        stream to ``path`` in 1MiB chunks, so a Range-less server
+        costs disk writes, never a whole multi-GB blob in RAM.
+        Returns ``(kind, nbytes_written, stream_sha256 or "")`` with
+        kind ``"partial"``/``"full"``, or None on failure."""
+        resp = self._ranged_blob_get(digest, start, end, stream_to=path)
+        if resp is None:
+            return None
+        sha = resp.stream_sha256
+        if resp.body:
+            # Transport without streaming support (fixtures).
+            with open(path, "wb") as f:
+                f.write(resp.body)
+            import hashlib
+            sha = hashlib.sha256(resp.body).hexdigest()
+        return (("partial" if resp.status == 206 else "full"),
+                os.path.getsize(path), sha)
 
     # -- push -------------------------------------------------------------
 
@@ -519,8 +594,8 @@ class RegistryClient:
         start = time.time()
         with metrics.span("registry_push", registry=self.registry,
                           repository=self.repository, tag=tag):
-            with ThreadPoolExecutor(self.config.concurrency) as pool:
-                concurrency.ctx_map(pool, self.push_layer, digests)
+            transfer.engine().map(self._transfer_push, sorted(
+                digests, key=str))
             self.push_manifest(tag, manifest)
         log.info("pushed %s/%s:%s", self.registry, self.repository, tag,
                  duration=time.time() - start)
@@ -539,6 +614,11 @@ class RegistryClient:
             if e.status == 404:
                 return False
             raise
+
+    def _transfer_push(self, digest: Digest) -> None:
+        with metrics.span("transfer", op="push",
+                          digest=Digest(digest).hex()[:12]):
+            self.push_layer(digest)
 
     def push_layer(self, digest: Digest) -> None:
         """Blob upload with existence check, chunked PATCH flow, and
@@ -577,21 +657,23 @@ class RegistryClient:
         chunk = self.config.push_chunk
         path = self.store.layers.path(digest.hex())
         size = os.path.getsize(path)
+        budget = transfer.engine().budget
         if size <= self.MONOLITHIC_MAX and (chunk <= 0 or chunk >= size):
-            with open(path, "rb") as f:
-                body = f.read()
-            self._limiter.wait(len(body))
-            sep = "&" if "?" in location else "?"
-            # Bytes-pushed counts the attempt (the body goes on the
-            # wire before a failure status comes back); blobs-pushed
-            # counts completions.
-            metrics.counter_add("makisu_registry_bytes_total",
-                                len(body), direction="push")
-            self._send("PUT", f"{location}{sep}digest={digest}",
-                       headers={"Content-Type":
-                                "application/octet-stream",
-                                "Content-Length": str(len(body))},
-                       body=body, accepted=(201, 204))
+            with budget.reserve(size):
+                with open(path, "rb") as f:
+                    body = f.read()
+                self._limiter.wait(len(body))
+                sep = "&" if "?" in location else "?"
+                # Bytes-pushed counts the attempt (the body goes on the
+                # wire before a failure status comes back); blobs-pushed
+                # counts completions.
+                metrics.counter_add("makisu_registry_bytes_total",
+                                    len(body), direction="push")
+                self._send("PUT", f"{location}{sep}digest={digest}",
+                           headers={"Content-Type":
+                                    "application/octet-stream",
+                                    "Content-Length": str(len(body))},
+                           body=body, accepted=(201, 204))
             metrics.counter_add("makisu_registry_blobs_total",
                                 direction="push")
             events.emit("registry_blob", direction="push",
@@ -602,18 +684,23 @@ class RegistryClient:
         with open(path, "rb") as f:
             off = 0
             while off < size:
-                piece = f.read(step)  # one chunk resident at a time
-                self._limiter.wait(len(piece))
-                metrics.counter_add("makisu_registry_bytes_total",
-                                    len(piece), direction="push")
-                resp = self._send(
-                    "PATCH", location,
-                    headers={
-                        "Content-Type": "application/octet-stream",
-                        "Content-Range": f"{off}-{off + len(piece) - 1}",
-                        "Content-Length": str(len(piece)),
-                    },
-                    body=piece, accepted=(202,))
+                # One chunk resident at a time, and that residency is
+                # charged against the global transfer budget so N
+                # parallel pushes can't stack N chunks unboundedly.
+                with budget.reserve(min(step, size - off)):
+                    piece = f.read(step)
+                    self._limiter.wait(len(piece))
+                    metrics.counter_add("makisu_registry_bytes_total",
+                                        len(piece), direction="push")
+                    resp = self._send(
+                        "PATCH", location,
+                        headers={
+                            "Content-Type": "application/octet-stream",
+                            "Content-Range":
+                                f"{off}-{off + len(piece) - 1}",
+                            "Content-Length": str(len(piece)),
+                        },
+                        body=piece, accepted=(202,))
                 off += len(piece)
                 location = self._absolute(
                     resp.header("location") or location)
@@ -625,6 +712,68 @@ class RegistryClient:
         events.emit("registry_blob", direction="push",
                     digest=digest.hex(), bytes=size,
                     registry=self.registry)
+
+
+class PullHandle:
+    """In-flight pipelined pull: one future per distinct blob digest.
+
+    ``wait_layer`` gates extraction on a single layer (the pipelining
+    seam FROM application uses); ``wait_all`` joins every download and
+    then saves the manifest under the image name — the manifest must
+    never be visible in the local store before all of its blobs are,
+    or a concurrent build would trust a manifest whose layers 404
+    locally."""
+
+    def __init__(self, client: "RegistryClient",
+                 name: "ImageName | None", tag: str,
+                 manifest: DistributionManifest, futures: dict) -> None:
+        self._client = client
+        self._name = name
+        self.tag = tag
+        self.manifest = manifest
+        self._futures = futures
+        self._finished = False
+
+    def wait_layer(self, digest: Digest) -> str:
+        """Block until one blob is in the local store; returns its
+        path. Unknown digests (the config blob, pulled eagerly) just
+        resolve through the store."""
+        future = self._futures.get(Digest(digest).hex())
+        if future is not None:
+            return future.result()
+        return self._client.store.layers.path(Digest(digest).hex())
+
+    def wait_all(self) -> DistributionManifest:
+        if not self._finished:
+            first_error = None
+            for future in self._futures.values():
+                try:
+                    future.result()
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    if first_error is None:
+                        first_error = e
+            if first_error is not None:
+                raise first_error
+            if self._name is not None:
+                self._client.store.manifests.save(self._name,
+                                                  self.manifest)
+            self._finished = True
+        return self.manifest
+
+    def abandon(self) -> None:
+        """The consumer failed mid-pull: cancel everything still
+        queued, join what is already running, and swallow download
+        errors — the build's original failure must not be masked, and
+        a failed build must not keep eating the engine capacity other
+        builds share."""
+        for future in self._futures.values():
+            future.cancel()
+        for future in self._futures.values():
+            if not future.cancelled():
+                try:
+                    future.result()
+                except BaseException:  # noqa: BLE001 - best-effort drain
+                    pass
 
 
 # Test seam: when set, new_client routes through this factory instead of

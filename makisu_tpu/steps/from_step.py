@@ -32,6 +32,10 @@ class FromStep(BuildStep):
         self.registry_client = None  # injected by the plan
         self._manifest: DistributionManifest | None = None
         self._config: ImageConfig | None = None
+        # Pipelined pull in flight (clients exposing start_pull): layer
+        # downloads run ahead on the transfer engine while execute()
+        # applies layers strictly in manifest order.
+        self._pull_handle = None
 
     @property
     def is_scratch(self) -> bool:
@@ -81,23 +85,42 @@ class FromStep(BuildStep):
                          self.image, config.os, config.architecture,
                          want_platform)
                 manifest = config = None
-        if manifest is None:
-            if self.registry_client is None:
-                raise RuntimeError(
-                    f"no registry client to pull base image {self.image}")
-            manifest = self.registry_client.pull(name)
-            config = read_config(manifest)
-            if want_platform and not self._platform_matches(
-                    config, want_platform):
+        try:
+            if manifest is None:
+                if self.registry_client is None:
+                    raise RuntimeError(
+                        f"no registry client to pull base image "
+                        f"{self.image}")
+                start_pull = getattr(self.registry_client, "start_pull",
+                                     None)
+                if start_pull is not None:
+                    # Pipelined: manifest + config arrive now, layer
+                    # blobs keep downloading while execute() extracts
+                    # in order.
+                    self._pull_handle = start_pull(name)
+                    manifest = self._pull_handle.manifest
+                else:
+                    manifest = self.registry_client.pull(name)
+                config = read_config(manifest)
+                if want_platform and not self._platform_matches(
+                        config, want_platform):
+                    raise ValueError(
+                        f"base image {self.image} is "
+                        f"{config.os}/{config.architecture}, but "
+                        f"MAKISU_TPU_PLATFORM wants {want_platform}")
+            self._manifest = manifest
+            self._config = config
+            if len(self._config.rootfs.diff_ids) != len(manifest.layers):
                 raise ValueError(
-                    f"base image {self.image} is "
-                    f"{config.os}/{config.architecture}, but "
-                    f"MAKISU_TPU_PLATFORM wants {want_platform}")
-        self._manifest = manifest
-        self._config = config
-        if len(self._config.rootfs.diff_ids) != len(manifest.layers):
-            raise ValueError(
-                "base image layer count mismatch between config and manifest")
+                    "base image layer count mismatch between config and "
+                    "manifest")
+        except BaseException:
+            # Any validation failure (unparseable config, platform or
+            # layer-count mismatch) must settle the in-flight pipelined
+            # pull — a failed build must not keep downloading layers on
+            # the engine capacity other builds share.
+            self._abandon_pull()
+            raise
 
     def execute(self, ctx: BuildContext, modify_fs: bool) -> None:
         if self.is_scratch:
@@ -105,18 +128,49 @@ class FromStep(BuildStep):
             return
         self._load(ctx)
         assert self._manifest is not None
-        for descriptor in self._manifest.layers:
-            log.info("applying FROM layer %s", descriptor.digest.hex())
-            with ctx.image_store.layers.open(descriptor.digest.hex()) as f:
-                with tario.gzip_reader(f) as gz:
-                    import tarfile
-                    with tarfile.open(fileobj=gz, mode="r|") as tf:
-                        ctx.memfs.update_from_tar(tf, untar=modify_fs)
+        try:
+            for descriptor in self._manifest.layers:
+                if self._pull_handle is not None:
+                    # Gate on THIS layer only: extraction of layer k
+                    # overlaps the wire time of layers k+1..
+                    # (application must stay in manifest order — each
+                    # layer's whiteouts overwrite the previous one's
+                    # state).
+                    self._pull_handle.wait_layer(descriptor.digest)
+                log.info("applying FROM layer %s", descriptor.digest.hex())
+                with ctx.image_store.layers.open(
+                        descriptor.digest.hex()) as f:
+                    with tario.gzip_reader(f) as gz:
+                        import tarfile
+                        with tarfile.open(fileobj=gz, mode="r|") as tf:
+                            ctx.memfs.update_from_tar(tf,
+                                                      untar=modify_fs)
+        except BaseException:
+            self._abandon_pull()
+            raise
+        self._finish_pull()
+
+    def _abandon_pull(self) -> None:
+        """The build failed mid-FROM: settle the in-flight pull without
+        masking the original error (queued downloads cancel, running
+        ones join, their errors are swallowed)."""
+        handle, self._pull_handle = self._pull_handle, None
+        if handle is not None:
+            handle.abandon()
+
+    def _finish_pull(self) -> None:
+        """Join any still-running downloads and save the manifest (a
+        no-op once done). Kept separate from execute so commit() can
+        settle the pull even on paths that never applied the layers."""
+        if self._pull_handle is not None:
+            self._pull_handle.wait_all()
+            self._pull_handle = None
 
     def commit(self, ctx: BuildContext) -> list[DigestPair]:
         if self.is_scratch:
             return []
         self._load(ctx)
+        self._finish_pull()
         assert self._manifest is not None and self._config is not None
         return [
             DigestPair(Digest(diff_id), desc)

@@ -110,6 +110,14 @@ class _State:
         # push rate limit is 100 MB/s, lib/registry/config.go:86-88)
         # instead of loopback's fantasy bandwidth.
         self.throttle_mbps = 0.0
+        # Fixed per-request latency (0 = none): models round-trip time
+        # so tests/benchmarks can PROVE transfer overlap — N requests
+        # overlapped take ~1 latency, serial take ~N.
+        self.latency_s = 0.0
+        # When False, Range headers are ignored and blob GETs always
+        # answer 200 (a legal response to any Range request) — tests
+        # exercise the client's whole-blob fallback against it.
+        self.serve_ranges = True
         # Byte accounting for benchmarks: blob bytes served / accepted.
         self.blob_bytes_out = 0
         self.blob_bytes_in = 0
@@ -207,6 +215,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.st.requests.append(
                 (verb, self.path.split("?")[0],
                  self.headers.get("traceparent", "")))
+        if self.st.latency_s > 0:
+            import time
+            time.sleep(self.st.latency_s)
         kind, groups, query = self._route()
         handler = getattr(self, f"_{verb.lower()}_{kind}", None)
         if kind == "" or handler is None:
@@ -236,19 +247,26 @@ class _Handler(BaseHTTPRequestHandler):
                         digest)
             return
         status = 200
+        headers = {
+            "Content-Type": "application/octet-stream",
+            "Docker-Content-Digest": digest,
+        }
         if self.command == "GET":
-            rng = _parse_range(self.headers.get("Range"), len(data))
+            total = len(data)
+            rng = (_parse_range(self.headers.get("Range"), total)
+                   if self.st.serve_ranges else None)
             if rng is not None:
                 start, end = rng
                 data = data[start:end]
                 status = 206
+                # RFC 9110 §14.4: 206 MUST carry Content-Range naming
+                # the satisfied span and the complete length.
+                headers["Content-Range"] = \
+                    f"bytes {start}-{end - 1}/{total}"
             self.st.wire_delay(len(data))
             with self.st.lock:
                 self.st.blob_bytes_out += len(data)
-        self._reply(status, data, {
-            "Content-Type": "application/octet-stream",
-            "Docker-Content-Digest": digest,
-        })
+        self._reply(status, data, headers)
 
     _get_blob = _head_blob
 
@@ -451,15 +469,19 @@ class MiniRegistry:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  verbose: bool = False,
-                 throttle_mbps: float = 0.0) -> None:
+                 throttle_mbps: float = 0.0,
+                 latency_s: float = 0.0,
+                 serve_ranges: bool = True) -> None:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         # Nagle + delayed-ACK interaction costs ~40ms PER REQUEST on
-        # loopback (urllib's header/body write-write-read pattern);
+        # loopback (the client's header/body write-write-read pattern);
         # chunk dedup issues thousands of small requests, so this
         # single flag is a ~50x throughput difference.
         self._server.disable_nagle_algorithm = True
         self._server.state = _State()
         self._server.state.throttle_mbps = throttle_mbps
+        self._server.state.latency_s = latency_s
+        self._server.state.serve_ranges = serve_ranges
         self._server.verbose = verbose
         self._server.daemon_threads = True
         self._thread: threading.Thread | None = None

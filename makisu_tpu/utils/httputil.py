@@ -6,8 +6,9 @@ TLS client config, https→http fallback :403-421, NetworkError
 classification).
 
 The ``Transport`` seam is what makes the registry client hermetically
-testable: the real transport speaks urllib; fixtures replay canned
-responses in-process (reference: mocks/net/http + registry fixtures).
+testable: the real transport speaks http.client over a per-origin
+keep-alive connection pool; fixtures replay canned responses in-process
+(reference: mocks/net/http + registry fixtures).
 """
 
 from __future__ import annotations
@@ -16,14 +17,20 @@ import dataclasses
 import http.client
 import socket
 import ssl
+import threading
 import time
-import urllib.error
-import urllib.request
+import urllib.parse
 from typing import BinaryIO
 
 from makisu_tpu.utils import metrics
 
 RETRYABLE_CODES = {408, 502, 503, 504}
+
+# Idle keep-alive connections kept per (scheme, host, port). Sized to
+# the transfer engine's default concurrency: more idle sockets than
+# concurrent requests would just hold fds a registry's LB will time out
+# anyway.
+POOL_MAX_IDLE = 16
 
 
 class HTTPError(Exception):
@@ -36,6 +43,12 @@ class HTTPError(Exception):
 
 class NetworkError(Exception):
     pass
+
+
+class _StaleConnection(Exception):
+    """Internal: a pooled keep-alive connection failed before the
+    server can have processed the request (send error, or closed with
+    zero response bytes) — retry once on a fresh connection."""
 
 
 @dataclasses.dataclass
@@ -53,9 +66,22 @@ class Response:
 
 
 class Transport:
-    """Performs one HTTP exchange. Bodies are fully materialized; layer
-    blobs stream via chunked PATCH uploads so each exchange stays
-    bounded."""
+    """Performs one HTTP exchange over a per-origin keep-alive pool.
+
+    Bodies are fully materialized; layer blobs stream via chunked PATCH
+    uploads so each exchange stays bounded. Connections are reused
+    across requests to the same (scheme, host, port): a registry pull
+    of N blobs used to pay N TCP+TLS handshakes — with parallel chunk
+    fetches that is thousands of handshakes per build, and handshake
+    RTTs, not bytes, dominated the wire time. 3xx responses are
+    returned to the caller, never followed (upload Location flows).
+    Thread-safe: a connection is checked out for exactly one exchange.
+
+    Known limitation vs the previous urllib transport: http(s)_proxy
+    environment variables are not honored — connections go straight to
+    the registry host. Registries only reachable through an egress
+    proxy need a network-layer proxy (or a transport subclass).
+    """
 
     def __init__(self, tls_verify: bool = True,
                  ca_cert: str | None = None,
@@ -66,15 +92,80 @@ class Transport:
         self.tls_verify = tls_verify
         self.ca_cert = ca_cert
         self.client_cert = client_cert
+        self._pool: dict[tuple[str, str, int],
+                         list[http.client.HTTPConnection]] = {}
+        self._pool_lock = threading.Lock()
+        self._ssl_ctx: ssl.SSLContext | None = None
 
     def _ssl_context(self) -> ssl.SSLContext:
-        ctx = ssl.create_default_context(cafile=self.ca_cert)
-        if not self.tls_verify:
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-        if self.client_cert:
-            ctx.load_cert_chain(*self.client_cert)
-        return ctx
+        # Cached: one context serves every pooled connection (building
+        # one per request would also defeat TLS session resumption).
+        if self._ssl_ctx is None:
+            ctx = ssl.create_default_context(cafile=self.ca_cert)
+            if not self.tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if self.client_cert:
+                ctx.load_cert_chain(*self.client_cert)
+            self._ssl_ctx = ctx
+        return self._ssl_ctx
+
+    def _origin(self, url: str) -> tuple[str, str, int, str]:
+        parts = urllib.parse.urlsplit(url)
+        scheme = parts.scheme or "http"
+        host = parts.hostname or ""
+        port = parts.port or (443 if scheme == "https" else 80)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        return scheme, host, port, path
+
+    def _checkout(self, scheme: str, host: str, port: int,
+                  timeout: float) -> tuple[http.client.HTTPConnection,
+                                           bool]:
+        """Pop an idle keep-alive connection for the origin, or open a
+        fresh one. Returns (conn, reused)."""
+        key = (scheme, host, port)
+        with self._pool_lock:
+            idle = self._pool.get(key)
+            if idle:
+                conn = idle.pop()
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                return conn, True
+        return self._new_conn(scheme, host, port, timeout), False
+
+    def _new_conn(self, scheme: str, host: str, port: int,
+                  timeout: float) -> http.client.HTTPConnection:
+        if scheme == "https":
+            return _NoDelayHTTPSConnection(host, port, timeout=timeout,
+                                           context=self._ssl_context())
+        return _NoDelayHTTPConnection(host, port, timeout=timeout)
+
+    def _checkin(self, scheme: str, host: str, port: int,
+                 conn: http.client.HTTPConnection) -> None:
+        key = (scheme, host, port)
+        with self._pool_lock:
+            idle = self._pool.setdefault(key, [])
+            if len(idle) < POOL_MAX_IDLE:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def _flush_origin(self, scheme: str, host: str, port: int) -> None:
+        with self._pool_lock:
+            idle = self._pool.pop((scheme, host, port), [])
+        for conn in idle:
+            conn.close()
+
+    def close(self) -> None:
+        """Close every idle pooled connection (tests, engine teardown)."""
+        with self._pool_lock:
+            pools, self._pool = self._pool, {}
+        for idle in pools.values():
+            for conn in idle:
+                conn.close()
 
     def round_trip(self, method: str, url: str, headers: dict[str, str],
                    body: bytes | BinaryIO | None = None,
@@ -85,50 +176,100 @@ class Transport:
         multi-GB blobs never materialize in memory."""
         if hasattr(body, "read"):
             body = body.read()
-        req = urllib.request.Request(url, data=body, method=method,
-                                     headers=headers)
-        opener = urllib.request.build_opener(
-            _NoDelayHTTPHandler(),
-            _NoDelayHTTPSHandler(context=self._ssl_context()),
-            _NoRedirect())
+        scheme, host, port, path = self._origin(url)
+        conn, reused = self._checkout(scheme, host, port, timeout)
         try:
-            with opener.open(req, timeout=timeout) as resp:
-                resp_headers = {k.lower(): v
-                                for k, v in resp.headers.items()}
-                if stream_to is not None and resp.status // 100 == 2:
-                    import hashlib
-                    digest = hashlib.sha256()
-                    with open(stream_to, "wb") as out:
-                        while True:
-                            chunk = resp.read(1 << 20)
-                            if not chunk:
-                                break
-                            digest.update(chunk)
-                            out.write(chunk)
-                    return Response(resp.status, resp_headers, b"",
-                                    stream_sha256=digest.hexdigest())
-                return Response(resp.status, resp_headers, resp.read())
-        except urllib.error.HTTPError as e:
-            data = e.read() if hasattr(e, "read") else b""
-            return Response(e.code,
-                            {k.lower(): v for k, v in e.headers.items()},
-                            data)
-        except (urllib.error.URLError, OSError, ssl.SSLError) as e:
+            return self._exchange(conn, scheme, host, port, method, path,
+                                  headers, body, stream_to,
+                                  retry_stale=reused)
+        except _StaleConnection:
+            # The pooled connection had been quietly closed by the
+            # server (keep-alive timeout): either the send itself
+            # failed, or zero response bytes arrived — in both cases
+            # the server did not process the request, so one retry is
+            # safe for any method. The origin's remaining idle sockets
+            # aged identically and are just as likely dead — flush
+            # them now rather than paying one failed round trip each —
+            # and the retry opens a genuinely fresh connection.
+            self._flush_origin(scheme, host, port)
+            conn = self._new_conn(scheme, host, port, timeout)
+            try:
+                return self._exchange(conn, scheme, host, port, method,
+                                      path, headers, body, stream_to,
+                                      retry_stale=False)
+            except (http.client.HTTPException, OSError, ssl.SSLError) as e:
+                conn.close()
+                raise NetworkError(f"{method} {url}: {e}") from e
+        except (http.client.HTTPException, OSError, ssl.SSLError) as e:
             raise NetworkError(f"{method} {url}: {e}") from e
 
+    def _exchange(self, conn: http.client.HTTPConnection, scheme: str,
+                  host: str, port: int, method: str, path: str,
+                  headers: dict[str, str], body: bytes | None,
+                  stream_to: str | None, retry_stale: bool) -> Response:
+        fresh = conn.sock is None
+        try:
+            conn.request(method, path, body=body, headers=headers)
+        except (http.client.HTTPException, OSError, ssl.SSLError):
+            conn.close()
+            if retry_stale:
+                raise _StaleConnection() from None
+            raise
+        metrics.counter_add("makisu_http_requests_total")
+        if fresh:
+            # request() opened the socket lazily; count the handshake
+            # only once it actually happened.
+            metrics.counter_add("makisu_http_connections_total",
+                                scheme=scheme)
+        try:
+            resp = conn.getresponse()
+        except http.client.RemoteDisconnected:
+            # Closed without ANY response bytes: the stale-keep-alive
+            # signature. Errors mid-response (IncompleteRead etc.) are
+            # NOT retried at this layer — the server may have acted on
+            # a non-idempotent request; send()'s status-aware retry
+            # owns that decision.
+            conn.close()
+            if retry_stale:
+                raise _StaleConnection() from None
+            raise
+        except (http.client.HTTPException, OSError, ssl.SSLError):
+            conn.close()
+            raise
+        resp_headers = {k.lower(): v for k, v in resp.getheaders()}
+        try:
+            if (stream_to is not None and resp.status // 100 == 2
+                    and method != "HEAD"):
+                import hashlib
+                digest = hashlib.sha256()
+                with open(stream_to, "wb") as out:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        digest.update(chunk)
+                        out.write(chunk)
+                result = Response(resp.status, resp_headers, b"",
+                                  stream_sha256=digest.hexdigest())
+            else:
+                result = Response(resp.status, resp_headers, resp.read())
+        except BaseException:
+            conn.close()  # a half-read body must never be pooled
+            raise
+        finally:
+            resp.close()
+        if resp.will_close:
+            conn.close()
+        else:
+            self._checkin(scheme, host, port, conn)
+        return result
 
-class _NoRedirect(urllib.request.HTTPRedirectHandler):
-    """Registry clients must see 3xx themselves (upload Location flows)."""
 
-    def redirect_request(self, *args, **kwargs):
-        return None
-
-
-# TCP_NODELAY on every client socket: urllib writes headers and body in
-# separate sends, and Nagle holding the second send for the delayed ACK
-# of the first costs ~40ms PER REQUEST. Chunk-granular dedup issues
-# thousands of small blob requests per layer — measured ~50x wall-clock
-# on the chunk push/fetch planes.
+# TCP_NODELAY on every client socket: http.client writes headers and
+# body in separate sends, and Nagle holding the second send for the
+# delayed ACK of the first costs ~40ms PER REQUEST. Chunk-granular
+# dedup issues thousands of small blob requests per layer — measured
+# ~50x wall-clock on the chunk push/fetch planes.
 
 
 class _NoDelayHTTPConnection(http.client.HTTPConnection):
@@ -141,17 +282,6 @@ class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
     def connect(self) -> None:
         super().connect()
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-
-
-class _NoDelayHTTPHandler(urllib.request.HTTPHandler):
-    def http_open(self, req):
-        return self.do_open(_NoDelayHTTPConnection, req)
-
-
-class _NoDelayHTTPSHandler(urllib.request.HTTPSHandler):
-    def https_open(self, req):
-        return self.do_open(_NoDelayHTTPSConnection, req,
-                            context=self._context)
 
 
 def send(transport: Transport, method: str, url: str,

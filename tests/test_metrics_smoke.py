@@ -112,3 +112,92 @@ def test_build_metrics_out_smoke(tmp_path, out_dir):
     assert len(path) >= 3
     total_self = sum(traceexport.self_time_by_name(report).values())
     assert total_self == pytest.approx(durs[0], rel=0.05)
+
+
+def test_pull_transfer_smoke(tmp_path, out_dir):
+    """Transfer-engine acceptance gate: a real pull over real TCP must
+    reuse keep-alive connections (connections counter strictly below
+    the requests counter) and report per-transfer spans, which land in
+    the uploaded trace artifact."""
+    import gzip
+    import hashlib
+    import io
+    import tarfile
+
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_CONFIG,
+        MEDIA_TYPE_LAYER,
+        Descriptor,
+        Digest,
+        DistributionManifest,
+        ImageConfig,
+    )
+    from makisu_tpu.tools.miniregistry import MiniRegistry
+
+    layer_blobs = []
+    for i in range(8):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w|") as tw:
+            info = tarfile.TarInfo(f"f{i}.bin")
+            payload = bytes([i]) * 2048
+            info.size = len(payload)
+            tw.addfile(info, io.BytesIO(payload))
+        layer_blobs.append(gzip.compress(buf.getvalue(), mtime=0))
+    config = ImageConfig()
+    config.rootfs.diff_ids = [
+        str(Digest.of_bytes(gzip.decompress(b))) for b in layer_blobs]
+    config_blob = config.to_bytes()
+    manifest = DistributionManifest(
+        config=Descriptor(MEDIA_TYPE_CONFIG, len(config_blob),
+                          Digest.of_bytes(config_blob)),
+        layers=[Descriptor(MEDIA_TYPE_LAYER, len(b), Digest.of_bytes(b))
+                for b in layer_blobs])
+
+    report_path = os.path.join(out_dir, "transfer-report.json")
+    trace_path = os.path.join(out_dir, "transfer-trace.json")
+    with MiniRegistry() as reg:
+        repo = reg.state.repo("smoke/transfer")
+        repo.blobs[str(Digest.of_bytes(config_blob))] = config_blob
+        for blob in layer_blobs:
+            repo.blobs[str(Digest.of_bytes(blob))] = blob
+        raw = manifest.to_bytes()
+        media = "application/vnd.docker.distribution.manifest.v2+json"
+        repo.manifests["1"] = (media, raw)
+        repo.manifests[str(Digest.of_bytes(raw))] = (media, raw)
+        repo.tags.add("1")
+
+        code = cli.main([
+            "--metrics-out", str(report_path),
+            "--trace-out", str(trace_path),
+            "pull", f"{reg.addr}/smoke/transfer:1",
+            "--storage", str(tmp_path / "storage"),
+        ])
+    assert code == 0
+    with open(report_path, encoding="utf-8") as f:
+        report = json.load(f)
+
+    def total(name):
+        return sum(s["value"] for s in report["counters"].get(name, []))
+
+    requests = total("makisu_http_requests_total")
+    connections = total("makisu_http_connections_total")
+    assert requests >= 10  # manifest + config + 8 layers
+    assert 0 < connections < requests, (connections, requests)
+    assert total("makisu_registry_blobs_total") >= 9
+
+    # Per-transfer spans in the report AND in the Perfetto artifact.
+    names = _span_names(report["spans"])
+    assert names.count("transfer") == 8
+    with open(trace_path, encoding="utf-8") as f:
+        trace = json.load(f)
+    transfer_slices = [e for e in trace["traceEvents"]
+                       if e.get("ph") == "X" and e["name"] == "transfer"]
+    assert len(transfer_slices) == 8
+
+    # The pulled bytes are digest-true on disk.
+    from makisu_tpu.storage import ImageStore
+    with ImageStore(str(tmp_path / "storage")) as store:
+        for desc in [manifest.config] + list(manifest.layers):
+            with store.layers.open(desc.digest.hex()) as f:
+                assert hashlib.sha256(
+                    f.read()).hexdigest() == desc.digest.hex()
