@@ -22,7 +22,7 @@ from makisu_tpu.docker.image import (
     Digest,
     DigestPair,
 )
-from makisu_tpu.utils import metrics
+from makisu_tpu.utils import events, metrics
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,6 +124,11 @@ class LayerSink:
         if self._queue is None:
             self._gz.write(data)
         self._tap(data)
+        # Hashing a huge layer is minutes of pure CPU with no events
+        # or logs; each landed buffer stamps the progress clock so the
+        # stall watchdog never mistakes a hard-working commit for a
+        # wedge (same discipline as httputil's stream loop).
+        events.note_progress()
         return len(data)
 
     def _tap(self, data: bytes) -> None:  # pragma: no cover - hook
@@ -252,6 +257,7 @@ class NativeLayerSink:
     def write(self, data: bytes) -> int:  # parity with LayerSink
         self._handle.write(bytes(data))
         self._nbytes += len(data)
+        events.note_progress()  # hashing is progress (see LayerSink)
         return len(data)
 
     def finish(self) -> LayerCommit:

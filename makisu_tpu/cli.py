@@ -58,6 +58,21 @@ def make_parser() -> argparse.ArgumentParser:
     parser.add_argument("--events-out", default="", metavar="FILE",
                         help="write this command's build events (JSONL, "
                              "one event per line) to FILE as they happen")
+    parser.add_argument("--diag-out", default="", metavar="FILE",
+                        help="write a JSON diagnostic bundle (flight-"
+                             "recorder ring, open spans, thread stacks, "
+                             "resource trajectory) to FILE on failure, "
+                             "stall, SIGTERM, or SIGUSR1; without it, "
+                             "bundles land in $MAKISU_TPU_DIAG_DIR when "
+                             "set (stall/signal dumps fall back to the "
+                             "tempdir)")
+    parser.add_argument("--stall-timeout", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="arm a stall watchdog: when the event bus "
+                             "and transfer engine make no progress for "
+                             "this long, emit a `stall` event and dump a "
+                             "diagnostic bundle (default off; env "
+                             "MAKISU_TPU_STALL_TIMEOUT)")
     parser.add_argument("--trace-out", default="", metavar="FILE",
                         help="write a Chrome/Perfetto trace-event JSON of "
                              "this command's span tree to FILE")
@@ -158,9 +173,19 @@ def make_parser() -> argparse.ArgumentParser:
     report = sub.add_parser(
         "report", help="critical-path analysis of a telemetry report")
     report.add_argument("metrics_file",
-                        help="a --metrics-out JSON report to analyze")
+                        help="a --metrics-out JSON report OR a "
+                             "diagnostic bundle (--diag-out) to analyze")
     report.add_argument("--events", default="", metavar="FILE",
-                        help="an --events-out JSONL log to include")
+                        help="an --events-out JSONL log to include "
+                             "(torn final lines of killed builds are "
+                             "salvaged)")
+
+    doctor = sub.add_parser(
+        "doctor", help="diagnose a failure-forensics bundle")
+    doctor.add_argument("bundle",
+                        help="a diagnostic bundle JSON (written by "
+                             "--diag-out, the stall watchdog, or the "
+                             "SIGTERM/SIGUSR1 handlers)")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -475,14 +500,26 @@ def _deep_diff(a, b, path: str = "") -> list[str]:
 def cmd_report(args) -> int:
     """Critical-path analysis of a build's telemetry: where the wall
     time went, what to attack first. Input is a ``--metrics-out`` JSON
-    report (and optionally the matching ``--events-out`` log)."""
+    report (and optionally the matching ``--events-out`` log) — or a
+    diagnostic bundle from a build that died mid-flight, whose embedded
+    metrics snapshot is analyzed instead: completed spans get phase
+    self-times, open spans are marked with their age at capture."""
     import json as json_mod
 
     from makisu_tpu.utils import events as events_mod
-    from makisu_tpu.utils import traceexport
+    from makisu_tpu.utils import flightrecorder, traceexport
 
     with open(args.metrics_file, encoding="utf-8") as f:
         report = json_mod.load(f)
+    capture_ts = None
+    if report.get("schema") == flightrecorder.BUNDLE_SCHEMA:
+        bundle, report = report, report.get("metrics")
+        capture_ts = bundle.get("ts")
+        if report is None:
+            raise SystemExit(
+                f"{args.metrics_file}: bundle carries no metrics "
+                f"snapshot (the dying process held the registry lock); "
+                f"try `makisu-tpu doctor` for the thread/span forensics")
     if report.get("schema") != "makisu-tpu.metrics.v1":
         raise SystemExit(
             f"{args.metrics_file}: not a makisu-tpu metrics report "
@@ -498,13 +535,48 @@ def cmd_report(args) -> int:
             log.warning("%s; analyzing the valid lines only", e)
             event_log = events_mod.read_jsonl(args.events,
                                               skip_invalid=True)
-    print(traceexport.render_report(report, event_log), end="")
+    print(traceexport.render_report(report, event_log,
+                                    capture_ts=capture_ts), end="")
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """Render a diagnostic bundle into a human diagnosis: the stuck
+    span, wedged threads, transfer-engine backlog, and the resource
+    trajectory leading up to the capture."""
+    import json as json_mod
+
+    from makisu_tpu.utils import flightrecorder
+
+    with open(args.bundle, encoding="utf-8") as f:
+        bundle = json_mod.load(f)
+    if bundle.get("schema") != flightrecorder.BUNDLE_SCHEMA:
+        raise SystemExit(
+            f"{args.bundle}: not a makisu-tpu diagnostic bundle "
+            f"(schema {bundle.get('schema')!r}); bundles are written "
+            f"by --diag-out, the stall watchdog, or SIGTERM/SIGUSR1")
+    print(flightrecorder.render_doctor(bundle), end="")
     return 0
 
 
 def cmd_worker(args) -> int:
+    from makisu_tpu.utils import flightrecorder
+    from makisu_tpu.utils import metrics as metrics_mod
     from makisu_tpu.worker import WorkerServer
-    server = WorkerServer(args.socket)
+    server = WorkerServer(args.socket,
+                          stall_window=(args.stall_timeout or
+                                        None),
+                          diag_out=args.diag_out)
+    # Process-level signal forensics: a worker killed by its
+    # supervisor (SIGTERM) or poked for live inspection (SIGUSR1)
+    # dumps a bundle covering EVERY in-flight build — the server's
+    # process recorder sees all contexts' events via the global sink,
+    # and the GLOBAL registry's trace id keeps every build's open
+    # spans in the bundle. This replaces BOTH per-invocation handlers
+    # cli.main installed, which would capture only the worker
+    # invocation's own (empty) context.
+    flightrecorder.install_signal_dumps(
+        server.recorder, metrics_mod.global_registry(), args.diag_out)
     log.info("worker listening on %s", args.socket)
     try:
         server.serve_forever()
@@ -529,7 +601,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
                 "diff": cmd_diff, "worker": cmd_worker,
-                "report": cmd_report}
+                "report": cmd_report, "doctor": cmd_doctor}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -564,6 +636,25 @@ def main(argv: list[str] | None = None) -> int:
         hasher=getattr(args, "hasher", "") or "",
         platform=os.environ.get("JAX_PLATFORMS", "") or "default",
         mode=invocation_mode.get())
+    # Failure forensics: every invocation arms a flight recorder (a
+    # lock-free ring of recent events/log records) and the process
+    # resource sampler. Cost when nothing goes wrong: one deque append
+    # per event. When something does — failure, stall, SIGTERM — the
+    # recorder dumps a diagnostic bundle `makisu-tpu doctor` can read.
+    from makisu_tpu.utils import flightrecorder, resources
+    resources.ensure_started()
+    # This invocation's own progress clock: every thread the build
+    # spawns inherits the cell, so a per-build stall watchdog in a
+    # busy worker watches THIS build, not its neighbors.
+    progress_token = events.bind_progress_cell()
+    recorder = flightrecorder.FlightRecorder()
+    recorder_tokens = flightrecorder.install(recorder)
+    # SIGTERM (the CI-timeout kill) dumps then unwinds; SIGUSR1 dumps
+    # and keeps building. Worker mode replaces these with
+    # process-level handlers (cmd_worker); in-worker builds run on
+    # handler threads, where install_signal_dumps is a no-op.
+    old_signal_handlers = flightrecorder.install_signal_dumps(
+        recorder, registry, args.diag_out, tag=registry.trace_id[:8])
     events_writer = None
     events_token = None
     if args.events_out:
@@ -573,6 +664,22 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:
             log.error("failed to open events log %s: %s",
                       args.events_out, e)
+    # The watchdog starts AFTER every event sink is bound: it runs
+    # under a copy of this context, so its `stall` event reaches the
+    # recorder, the --events-out log, and (in a worker) the client's
+    # live stream. The `worker` command is exempt: a per-invocation
+    # watchdog has no active_fn gate and would flag a healthy IDLE
+    # worker as stalled — cmd_worker's server arms its own, gated on
+    # in-flight builds.
+    watchdog = None
+    stall_timeout = (args.stall_timeout or
+                     flightrecorder.stall_timeout_from_env())
+    if stall_timeout > 0 and args.command != "worker":
+        watchdog = flightrecorder.StallWatchdog(
+            stall_timeout, recorder,
+            flightrecorder.forced_bundle_path(
+                args.diag_out, "stall", tag=registry.trace_id[:8]),
+            registry, cell=events.progress_cell()).start()
     # argv deliberately stays out of the event record: it can carry
     # credentials (--redis-cache-password, registry configs).
     events.emit("build_start", trace_id=registry.trace_id,
@@ -583,6 +690,14 @@ def main(argv: list[str] | None = None) -> int:
         with metrics.span(args.command or "cli"):
             code = handler(args)
         return code
+    except SystemExit as e:
+        # A signal handler's SystemExit(143) or a subcommand's
+        # SystemExit(msg) unwinds through here: record the true exit
+        # code so build_end (and the failure-dump gate) see 143/1,
+        # not the untouched sentinel.
+        code = (e.code if isinstance(e.code, int)
+                else 0 if e.code is None else 1)
+        raise
     except Exception as e:  # noqa: BLE001 - top-level CLI boundary
         log.error("failed to execute command: %s", e)
         if args.log_level == "debug":
@@ -591,11 +706,43 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         events.emit("build_end", trace_id=registry.trace_id,
                     exit_code=code)
+        if watchdog is not None:
+            watchdog.stop()
+        flightrecorder.restore_signal_handlers(old_signal_handlers)
+        if (code != 0
+                and args.command in ("build", "pull", "push", "diff")
+                and not recorder.captured_terminal_moment()):
+            # A stall/SIGTERM dump already froze the interesting
+            # moment (a SIGUSR1 inspection poke doesn't count);
+            # otherwise a plain failure dumps here (opt-in via
+            # --diag-out / $MAKISU_TPU_DIAG_DIR — red CI runs upload
+            # the bundle as an artifact). Only real-work commands
+            # dump: a failed `report`/`doctor` analysis has no build
+            # to do forensics ON, and the `worker` command's
+            # forensics are the PROCESS-level handlers in cmd_worker
+            # — this invocation-scoped recorder, blind to the builds,
+            # would clobber the SIGTERM bundle they just wrote at the
+            # same --diag-out path.
+            diag_path = flightrecorder.resolve_bundle_path(
+                args.diag_out, "failure", tag=registry.trace_id[:8])
+            if diag_path:
+                try:
+                    recorder.dump(diag_path, "failure", registry,
+                                  exit_code=code)
+                    log.info("diagnostic bundle written to %s",
+                             diag_path)
+                except OSError as e:
+                    log.error("failed to write diagnostic bundle: %s", e)
+        elif recorder.last_dump_path:
+            log.info("diagnostic bundle written to %s",
+                     recorder.last_dump_path)
         if events_token is not None:
             events.reset_sink(events_token)
         if events_writer is not None:
             events_writer.close()
             log.info("event log written to %s", args.events_out)
+        flightrecorder.uninstall(recorder_tokens)
+        events.reset_progress_cell(progress_token)
         metrics.reset_build_registry(metrics_token)
         if jax_trace:
             import jax

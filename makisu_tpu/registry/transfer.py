@@ -29,11 +29,32 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Iterable
 
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
+
+# Monotonic timestamp of the engine's last observable progress (task
+# admitted or finished, budget bytes released) — the transfer half of
+# the build-progress clock; utils/flightrecorder.py combines it with
+# the event bus's half for the stall watchdog and /healthz.
+_last_progress = time.monotonic()
+
+
+def last_progress_monotonic() -> float:
+    return _last_progress
+
+
+def _note_progress() -> None:
+    global _last_progress
+    _last_progress = time.monotonic()
+    # Also stamp the calling build's per-context progress cell (task
+    # bodies run under the submitter's copied context): a per-build
+    # watchdog must see ITS transfers move, not just the process's.
+    events.note_progress()
 
 DEFAULT_CONCURRENCY = 8
 DEFAULT_MEMORY_BUDGET = 256 * 1024 * 1024   # bytes in flight across pools
@@ -79,6 +100,7 @@ class MemoryBudget:
 
     def release(self, nbytes: int) -> None:
         nbytes = max(int(nbytes), 0)
+        _note_progress()  # bytes landed: the transfer is moving
         with self._cond:
             self._used = max(self._used - nbytes, 0)
             metrics.gauge_set("makisu_transfer_inflight_bytes",
@@ -119,14 +141,34 @@ class TransferEngine:
     # -- queue-depth accounting -------------------------------------------
 
     def _enter(self) -> None:
+        _note_progress()
         with self._depth_lock:
             self._depth += 1
             metrics.gauge_set("makisu_transfer_queue_depth", self._depth)
 
     def _exit(self) -> None:
+        _note_progress()
         with self._depth_lock:
             self._depth = max(self._depth - 1, 0)
             metrics.gauge_set("makisu_transfer_queue_depth", self._depth)
+
+    def snapshot(self) -> dict[str, Any]:
+        """In-flight state for diagnostic bundles: how much work (and
+        memory) was mid-air when the build died. Deliberately
+        LOCK-FREE dirty reads: a signal handler may call this having
+        interrupted a frame that holds ``_depth_lock`` or the budget
+        condition — int attribute reads are atomic under the GIL and
+        a slightly stale value is fine for forensics, a deadlocked
+        dying process is not."""
+        return {
+            "queue_depth": self._depth,
+            "inflight_bytes": self.budget._used,
+            "budget_limit_bytes": self.budget.limit,
+            "concurrency": self.concurrency,
+            "part_size_bytes": self.part_size,
+            "last_progress_seconds": round(
+                time.monotonic() - _last_progress, 3),
+        }
 
     # -- blob-granular API -------------------------------------------------
 
@@ -285,6 +327,15 @@ def engine() -> TransferEngine:
         if _engine is None:
             _engine = TransferEngine()
         return _engine
+
+
+def peek() -> TransferEngine | None:
+    """The live engine WITHOUT creating one — diagnostics must not
+    spin up transfer pools in a process that never transferred.
+    Lock-free on purpose: a signal handler calls this and may have
+    interrupted a frame inside engine()/configure() that holds
+    ``_engine_lock``; a module-global read is atomic under the GIL."""
+    return _engine
 
 
 def set_engine(new: TransferEngine | None) -> TransferEngine | None:

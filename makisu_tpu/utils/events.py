@@ -27,7 +27,21 @@ Types emitted today: ``build_start``/``build_end`` (cli.py),
 emit new types; consumers must ignore types they don't know.
 
 Like the rest of the telemetry layer: stdlib-only, import-cycle-free,
-and never able to fail a build — a raising sink is swallowed.
+and never able to fail a build — a raising sink is swallowed (and
+counted in ``makisu_events_dropped_total``, so a lossy event log is
+detectable instead of silently incomplete).
+
+Beyond the context-scoped sinks, two process-wide facilities ride on
+``emit``:
+
+- **global sinks** (:func:`add_global_sink`) see every context's
+  events — the worker's process-level flight recorder uses this to
+  keep a last-N ring across all builds it serves.
+- **the progress clock**: every ``emit`` stamps a monotonic timestamp
+  (:func:`last_emit_monotonic`) even when no sink is bound — one float
+  store, the cheapest possible liveness signal. The stall watchdog
+  (``utils/flightrecorder.py``) and the worker's ``/healthz``
+  ``last_progress_seconds`` read it.
 """
 
 from __future__ import annotations
@@ -43,6 +57,95 @@ EventSink = Callable[[dict], None]
 _sinks: "contextvars.ContextVar[tuple[EventSink, ...]]" = \
     contextvars.ContextVar("makisu_event_sinks", default=())
 
+# Process-wide sinks (worker flight recorder); mutated rarely, read on
+# every emit. Kept as a tuple swapped whole so readers never see a
+# half-updated list.
+_global_sinks: tuple[EventSink, ...] = ()
+_global_sinks_lock = threading.Lock()
+
+# Monotonic timestamp of the last emit — the event bus's half of the
+# build-progress clock (the transfer engine keeps the other half).
+_last_emit = time.monotonic()
+
+
+def last_emit_monotonic() -> float:
+    """``time.monotonic()`` of the most recent :func:`emit` call (any
+    context, sink bound or not) — or of an explicit
+    :func:`note_progress` (the log path stamps it, so a build that
+    logs without emitting events still reads as alive)."""
+    return _last_emit
+
+
+# Contexts whose activity must NOT count as build progress: the stall
+# watchdog's own `stall` emit and warning log would otherwise reset the
+# very clock it watches — one wedge would re-fire every window and
+# /healthz's last_progress_seconds could never exceed it.
+_suppress_progress: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("makisu_suppress_progress", default=False)
+
+
+def suppress_progress_stamps():
+    """Mark the current context (typically a forensics thread's copied
+    context) as not-progress. Returns a reset token."""
+    return _suppress_progress.set(True)
+
+
+# Per-build progress cell: a one-element [monotonic] list bound in the
+# build's context. copy_context shares the SAME list with every thread
+# the build spawns, so all of a build's activity stamps one cell — and
+# a per-build stall watchdog reads THAT cell instead of the process
+# clock, which sibling builds in a worker keep fresh (a wedged build
+# must not be masked by a healthy neighbor's progress).
+_progress_cell: "contextvars.ContextVar[list[float] | None]" = \
+    contextvars.ContextVar("makisu_progress_cell", default=None)
+
+
+def bind_progress_cell():
+    """Bind a fresh per-build progress cell in the current context
+    (``cli.main`` does this before spawning any build thread).
+    Returns a reset token."""
+    return _progress_cell.set([time.monotonic()])
+
+
+def reset_progress_cell(token) -> None:
+    _progress_cell.reset(token)
+
+
+def progress_cell() -> list[float] | None:
+    """The context's progress cell, if one is bound."""
+    return _progress_cell.get()
+
+
+def note_progress() -> None:
+    """Stamp the progress clock(s) without emitting an event. Two
+    float stores — cheap enough for any hot path that proves
+    liveness."""
+    if _suppress_progress.get():
+        return
+    global _last_emit
+    _last_emit = time.monotonic()
+    cell = _progress_cell.get()
+    if cell is not None:
+        cell[0] = _last_emit
+
+
+def add_global_sink(sink: EventSink) -> None:
+    """Register a process-wide sink that sees every context's events.
+    Unlike context sinks this is not scoped — use for process-level
+    consumers (the worker's flight recorder), and remove symmetrically
+    with :func:`remove_global_sink`."""
+    global _global_sinks
+    with _global_sinks_lock:
+        _global_sinks = _global_sinks + (sink,)
+
+
+def remove_global_sink(sink: EventSink) -> None:
+    global _global_sinks
+    with _global_sinks_lock:
+        # Equality, not identity: bound methods are recreated per
+        # attribute access, and two equal bound methods name one sink.
+        _global_sinks = tuple(s for s in _global_sinks if s != sink)
+
 
 def add_sink(sink: EventSink):
     """Bind an event sink in the current context (stacking on any
@@ -55,15 +158,18 @@ def reset_sink(token) -> None:
 
 
 def active() -> bool:
-    """Whether any sink is bound in this context (lets callers skip
-    building expensive event payloads)."""
-    return bool(_sinks.get())
+    """Whether any sink (context or global) would receive an emit
+    (lets callers skip building expensive event payloads)."""
+    return bool(_sinks.get() or _global_sinks)
 
 
 def emit(event_type: str, **fields: Any) -> None:
-    """Deliver one event to every bound sink. No sink: free no-op.
-    A sink that raises is ignored — events must never fail a build."""
-    sinks = _sinks.get()
+    """Deliver one event to every bound sink. No sink: free no-op
+    (plus one float store for the progress clock). A sink that raises
+    is ignored — events must never fail a build — but the drop is
+    counted so consumers can tell their log is incomplete."""
+    note_progress()
+    sinks = _sinks.get() + _global_sinks
     if not sinks:
         return
     event: dict[str, Any] = {"ts": round(time.time(), 6),
@@ -73,7 +179,13 @@ def emit(event_type: str, **fields: Any) -> None:
         try:
             sink(event)
         except Exception:  # noqa: BLE001 - a dead sink must not kill a build
-            pass
+            # Lazy import: metrics imports this module at its top.
+            try:
+                from makisu_tpu.utils import metrics
+                metrics.counter_add("makisu_events_dropped_total",
+                                    event_type=event_type)
+            except Exception:  # noqa: BLE001 - never recurse into failure
+                pass
 
 
 class JsonlWriter:

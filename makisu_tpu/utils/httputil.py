@@ -241,6 +241,7 @@ class Transport:
             if (stream_to is not None and resp.status // 100 == 2
                     and method != "HEAD"):
                 import hashlib
+                from makisu_tpu.utils import events as events_mod
                 digest = hashlib.sha256()
                 with open(stream_to, "wb") as out:
                     while True:
@@ -249,6 +250,10 @@ class Transport:
                             break
                         digest.update(chunk)
                         out.write(chunk)
+                        # Each landed buffer stamps the progress clock:
+                        # a slow multi-GB streaming pull is PROGRESS,
+                        # not a stall, even between telemetry events.
+                        events_mod.note_progress()
                 result = Response(resp.status, resp_headers, b"",
                                   stream_sha256=digest.hexdigest())
             else:

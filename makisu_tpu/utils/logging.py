@@ -12,6 +12,8 @@ import sys
 import time
 from typing import Any, Callable
 
+from makisu_tpu.utils import events
+
 _LOGGER_NAME = "makisu"
 
 # Per-build log sink (worker mode): each /build request binds its own
@@ -34,6 +36,26 @@ def set_build_sink(sink: "Callable[[str, str, dict], None] | None",
 
 def reset_build_sink(token) -> None:
     _build_sink.reset(token)
+
+
+# Context-scoped log taps: lightweight observers receiving EVERY record
+# regardless of level, stacking like the event-bus sinks. The flight
+# recorder (utils/flightrecorder.py) binds one per build so diagnostic
+# bundles carry the last-N log records. Unlike the build sink, taps are
+# many and level-blind — a ring buffer wants debug lines too.
+_taps: "contextvars.ContextVar[tuple[Callable, ...]]" = \
+    contextvars.ContextVar("makisu_log_taps", default=())
+
+
+def add_tap(tap: "Callable[[str, str, dict], None]"):
+    """Bind a (level, message, fields) observer in the current context,
+    stacking on any already bound. Returns a token for
+    :func:`reset_tap`."""
+    return _taps.set(_taps.get() + (tap,))
+
+
+def reset_tap(token) -> None:
+    _taps.reset(token)
 
 
 class _JsonFormatter(logging.Formatter):
@@ -106,7 +128,16 @@ def get_logger() -> logging.Logger:
 def _log(level: int, msg: str, *args: Any, **fields: Any) -> None:
     if args:
         msg = msg % args
+    # A log line proves the process is alive: stamp the progress clock
+    # so a build that logs (a long RUN step draining output) without
+    # emitting bus events doesn't read as stalled to the watchdog.
+    events.note_progress()
     get_logger().log(level, msg, extra={"fields": fields} if fields else {})
+    for tap in _taps.get():
+        try:
+            tap(logging.getLevelName(level).lower(), msg, fields)
+        except Exception:  # noqa: BLE001 - a dead tap must not kill logging
+            pass
     bound = _build_sink.get()
     if bound is not None:
         sink, threshold = bound

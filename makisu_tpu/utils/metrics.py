@@ -63,7 +63,8 @@ class Span:
     issued the request."""
 
     __slots__ = ("name", "attrs", "start_unix", "duration", "error",
-                 "children", "registry", "span_id", "parent_id", "_t0")
+                 "children", "registry", "span_id", "parent_id", "_t0",
+                 "peak_rss", "cpu_seconds")
 
     def __init__(self, name: str, attrs: dict[str, Any],
                  registry: "MetricsRegistry") -> None:
@@ -77,6 +78,12 @@ class Span:
         self.registry = registry
         self.span_id = new_id(8)
         self.parent_id = ""
+        # Filled by the resource sampler (utils/resources.py) while the
+        # span is open: peak process RSS observed, and the CPU seconds
+        # charged to this span while it was an open LEAF. None = never
+        # sampled (sampler off, or span shorter than the interval).
+        self.peak_rss: int | None = None
+        self.cpu_seconds = 0.0
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -92,6 +99,11 @@ class Span:
             out["attrs"] = dict(self.attrs)
         if self.error:
             out["error"] = self.error
+        if self.peak_rss is not None:
+            out["resources"] = {
+                "peak_rss_bytes": int(self.peak_rss),
+                "cpu_seconds": round(self.cpu_seconds, 6),
+            }
         if self.children:
             out["children"] = [c.to_dict() for c in self.children]
         return out
@@ -170,6 +182,16 @@ class MetricsRegistry:
         with self._lock:
             series = self._counters.get(name, {})
             return sum(v for k, v in series.items() if want <= set(k))
+
+    def gauge_value(self, name: str, default: float = 0.0,
+                    **labels: Any) -> float:
+        """Current value of one gauge series (exact label match; no
+        labels reads the unlabeled series). What the worker's
+        ``/healthz`` uses to surface transfer-engine gauges without a
+        Prometheus scrape."""
+        with self._lock:
+            return self._gauges.get(name, {}).get(
+                _label_key(labels), default)
 
     def counter_by_label(self, name: str, label: str) -> dict[str, float]:
         """Grand total of ``name`` broken down by one label's values."""
@@ -250,6 +272,73 @@ def _targets() -> tuple[MetricsRegistry, ...]:
     return (_global, bound)
 
 
+# Every span open in the process, across all registries: the resource
+# sampler attributes RSS/CPU to these, and the flight recorder snapshots
+# them (with ages) into diagnostic bundles. A plain dict keyed by id():
+# single-item inserts/deletes are atomic under the GIL, so readers —
+# including a SIGTERM handler that interrupted arbitrary code — never
+# need a lock that the interrupted frame might hold.
+_open_spans: dict[int, Span] = {}
+
+
+def snapshot_concurrent(container) -> list:
+    """``list(container)`` against a structure other threads mutate
+    WITHOUT taking a lock: retried on the RuntimeError a concurrent
+    resize raises, empty after four straight losses. The forensics
+    paths (signal handlers included) read every shared structure this
+    way — a lock the interrupted frame might hold must never be
+    taken."""
+    for _ in range(4):
+        try:
+            return list(container)
+        except RuntimeError:  # mutated mid-iteration; retry
+            continue
+    return []  # pragma: no cover - four consecutive races
+
+
+def open_span_snapshot() -> list[dict[str, Any]]:
+    """Every open span as a JSON-ready dict with its age, sorted
+    oldest-first. ``leaf`` marks spans with no open child — where the
+    build actually is. Lock-free (retried on concurrent mutation) so
+    the flight recorder can call it from a signal handler."""
+    spans = snapshot_concurrent(_open_spans.values())
+    now = time.monotonic()
+    parent_ids = {s.parent_id for s in spans}
+    out = []
+    for s in spans:
+        out.append({
+            "name": s.name,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "trace_id": s.registry.trace_id,
+            "start": round(s.start_unix, 6),
+            "age_seconds": round(now - s._t0, 3),
+            "attrs": dict(s.attrs),
+            "leaf": s.span_id not in parent_ids,
+        })
+    out.sort(key=lambda d: -d["age_seconds"])
+    return out
+
+
+def attribute_resource_sample(rss_bytes: int, cpu_delta: float) -> None:
+    """Charge one resource sample to the open spans: every open span
+    tracks the peak RSS observed while it was open; the CPU burned
+    since the previous sample is split evenly across the open LEAF
+    spans (concurrent builds share the process's CPU — an even split
+    is the honest default). Called by ``utils/resources.py``."""
+    spans = snapshot_concurrent(_open_spans.values())
+    if not spans:
+        return
+    parent_ids = {s.parent_id for s in spans}
+    leaves = [s for s in spans if s.span_id not in parent_ids]
+    share = cpu_delta / len(leaves) if leaves else 0.0
+    for s in spans:
+        if s.peak_rss is None or rss_bytes > s.peak_rss:
+            s.peak_rss = rss_bytes
+    for s in leaves:
+        s.cpu_seconds += share
+
+
 def counter_add(name: str, value: float = 1.0, **labels: Any) -> None:
     for reg in _targets():
         reg.counter_add(name, value, **labels)
@@ -281,6 +370,7 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
     s.parent_id = parent.span_id
     with reg._lock:
         parent.children.append(s)
+    _open_spans[id(s)] = s
     token = _current_span.set(s)
     events.emit("span_start", name=name, span_id=s.span_id,
                 parent_id=s.parent_id,
@@ -292,6 +382,7 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
         raise
     finally:
         s.duration = time.monotonic() - s._t0
+        _open_spans.pop(id(s), None)
         _current_span.reset(token)
         events.emit("span_end", name=name, span_id=s.span_id,
                     duration=round(s.duration, 6),

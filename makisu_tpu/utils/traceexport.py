@@ -167,6 +167,47 @@ def phase_totals(report: dict) -> dict[str, float]:
     return totals
 
 
+def open_spans_in(report: dict) -> list[dict]:
+    """Spans with a null duration — open when the report was captured,
+    i.e. the process died (or was snapshotted) mid-span. A finished
+    build's report has none; a flight-recorder bundle's metrics
+    snapshot typically has the whole stuck chain."""
+    out = []
+    for top in report.get("spans") or []:
+        for span, depth in _walk(top):
+            if span.get("duration") is None:
+                out.append({
+                    "name": span.get("name", "?"),
+                    "depth": depth,
+                    "start": float(span.get("start", 0.0)),
+                    "attrs": span.get("attrs", {}),
+                })
+    return out
+
+
+def resources_by_phase(report: dict) -> dict[str, dict[str, float]]:
+    """Peak RSS and CPU seconds per build phase, from the per-span
+    resource attribution the sampler recorded (utils/resources.py).
+    Peak RSS is a max (it is a process-wide level observed while the
+    span was open); CPU sums the per-leaf charges, so phases are
+    roughly exclusive."""
+    out: dict[str, dict[str, float]] = {}
+    for top in report.get("spans") or []:
+        for span, _depth in _walk(top):
+            res = span.get("resources")
+            if not res:
+                continue
+            phase = phase_of(span.get("name", ""))
+            agg = out.setdefault(phase,
+                                 {"peak_rss_bytes": 0.0,
+                                  "cpu_seconds": 0.0})
+            agg["peak_rss_bytes"] = max(agg["peak_rss_bytes"],
+                                        float(res.get("peak_rss_bytes",
+                                                      0)))
+            agg["cpu_seconds"] += float(res.get("cpu_seconds", 0.0))
+    return out
+
+
 # -- counters --------------------------------------------------------------
 
 
@@ -196,7 +237,9 @@ def bytes_hashed_by_backend(report: dict) -> dict[str, float]:
 # -- the `makisu-tpu report` text ------------------------------------------
 
 
-def _fmt_bytes(n: float) -> str:
+def fmt_bytes(n: float) -> str:
+    """Human byte count; shared by this report and `doctor`
+    (utils/flightrecorder.py) so the two outputs can't drift."""
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
             return (f"{n:.1f}{unit}" if unit != "B"
@@ -205,10 +248,18 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
 
 
-def render_report(report: dict, event_log: list[dict] | None = None) -> str:
+_fmt_bytes = fmt_bytes  # internal call sites predate the public name
+
+
+def render_report(report: dict, event_log: list[dict] | None = None,
+                  capture_ts: float | None = None) -> str:
     """The ``makisu-tpu report`` output: critical path, phase
-    breakdown, top time sinks, cache/hashing counters, and (with an
-    event log) an event-type census."""
+    breakdown, top time sinks, cache/hashing counters, resource usage
+    per phase (when the sampler ran), and (with an event log) an
+    event-type census. Handles a build that died mid-flight: open
+    spans (null durations) are listed and marked, completed spans
+    still get phase self-times, and ``capture_ts`` (a bundle's capture
+    moment) substitutes for the missing root wall time."""
     lines: list[str] = []
     top = root_span(report)
     command = report.get("command") or (top or {}).get("name") or "?"
@@ -219,7 +270,12 @@ def render_report(report: dict, event_log: list[dict] | None = None) -> str:
         lines.append("no spans recorded (empty report)")
         return "\n".join(lines) + "\n"
     total = _duration(top)
+    died_open = top.get("duration") is None
+    if died_open and capture_ts:
+        total = max(capture_ts - float(top.get("start", capture_ts)), 0.0)
     lines.append(f"wall time: {total:.3f}s"
+                 + ("  (build died mid-flight; root span never closed)"
+                    if died_open else "")
                  + (f"  exit code: {report['exit_code']}"
                     if "exit_code" in report else ""))
 
@@ -238,10 +294,39 @@ def render_report(report: dict, event_log: list[dict] | None = None) -> str:
         lines.append(f"  {indent}{label:<40s} {hop['duration']:9.3f}s "
                      f"{pct:5.1f}%  (self {hop['self']:.3f}s)")
 
+    open_spans = open_spans_in(report)
+    if open_spans:
+        lines.append("")
+        lines.append(f"spans still open at capture ({len(open_spans)}) "
+                     "— where the build was when it died:")
+        for span in open_spans:
+            detail = ", ".join(f"{k}={v}" for k, v in
+                               sorted(span["attrs"].items()))
+            label = span["name"] + (f" [{detail}]" if detail else "")
+            indent = "  " * span["depth"]
+            age = ""
+            if capture_ts:
+                age = f"  open {max(capture_ts - span['start'], 0.0):.1f}s"
+            lines.append(f"  {indent}{label:<40s} ✱ open{age}")
+
     phases = phase_totals(report)
     lines.append("")
-    lines.append("phase breakdown (self time): " + "  ".join(
-        f"{phase}={phases[phase]:.3f}s" for phase in PHASES))
+    lines.append("phase breakdown (self time, completed spans): "
+                 + "  ".join(
+                     f"{phase}={phases[phase]:.3f}s" for phase in PHASES))
+
+    resources = resources_by_phase(report)
+    if resources:
+        lines.append("")
+        lines.append("resource usage by phase (sampled):")
+        for phase in PHASES:
+            agg = resources.get(phase)
+            if not agg:
+                continue
+            lines.append(
+                f"  {phase:<6s} peak rss "
+                f"{_fmt_bytes(agg['peak_rss_bytes']):>10s}   cpu "
+                f"{agg['cpu_seconds']:8.3f}s")
 
     sinks = sorted(self_time_by_name(report).items(),
                    key=lambda kv: kv[1], reverse=True)[:5]

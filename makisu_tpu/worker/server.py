@@ -174,7 +174,9 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, socket_path: str) -> None:
+    def __init__(self, socket_path: str,
+                 stall_window: float | None = None,
+                 diag_out: str = "") -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
@@ -211,6 +213,31 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # the filesystem; those (and only those) serialize.
         self._path_locks: dict[str, threading.Lock] = {}
         self._path_locks_mu = threading.Lock()
+        # Failure forensics: a process-level flight recorder sees every
+        # build's events (global sink — per-build recorders inside each
+        # cli.main still keep isolated rings), the resource sampler
+        # feeds RSS/CPU gauges and span attribution, and an optional
+        # stall watchdog (MAKISU_TPU_STALL_TIMEOUT seconds) dumps a
+        # bundle when in-flight builds stop making progress.
+        from makisu_tpu.utils import events, flightrecorder, resources
+        resources.ensure_started()
+        self.recorder = flightrecorder.FlightRecorder()
+        self._recorder_sink = self.recorder.record_event
+        events.add_global_sink(self._recorder_sink)
+        self._watchdog = None
+        if stall_window is None:
+            stall_window = flightrecorder.stall_timeout_from_env()
+        if stall_window > 0:
+            from makisu_tpu.utils import metrics
+            self._watchdog = flightrecorder.StallWatchdog(
+                stall_window, self.recorder,
+                flightrecorder.forced_bundle_path(diag_out, "stall"),
+                # Explicitly the PROCESS registry: this thread's copied
+                # context carries the worker invocation's per-build
+                # registry (cli.main bound it before cmd_worker ran),
+                # whose trace filter would drop every build's spans.
+                registry=metrics.global_registry(),
+                active_fn=lambda: self._active_builds() > 0).start()
 
     # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
     # wants a (host, port) tuple for logging.
@@ -300,14 +327,23 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             events.reset_sink(events_token)
             log.reset_build_sink(token)
 
+    def _active_builds(self) -> int:
+        with self._health_mu:
+            return (self._builds_started - self._builds_succeeded
+                    - self._builds_failed)
+
     def health(self) -> dict:
-        """The ``GET /healthz`` payload: uptime and build outcome
-        counts (active = started - finished; a build blocked on a
-        shared --root/--storage path lock counts as active)."""
+        """The ``GET /healthz`` payload: uptime, build outcome counts
+        (active = started - finished; a build blocked on a shared
+        --root/--storage path lock counts as active), the progress
+        clock, and the transfer engine's gauges — a wedged transfer
+        plane is visible to a probe without scraping /metrics."""
+        from makisu_tpu.utils import flightrecorder, metrics
         with self._health_mu:
             started = self._builds_started
             succeeded = self._builds_succeeded
             failed = self._builds_failed
+        g = metrics.global_registry()
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -316,7 +352,25 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "builds_succeeded": succeeded,
             "builds_failed": failed,
             "active_builds": started - succeeded - failed,
+            # Seconds since the last observable progress (event bus,
+            # log line, or transfer-engine work). A probe alerting on
+            # active_builds > 0 && last_progress_seconds > window sees
+            # a stalled worker without the watchdog being armed.
+            "last_progress_seconds": round(
+                flightrecorder.last_progress_seconds(), 3),
+            "transfer_inflight_bytes": int(g.gauge_value(
+                "makisu_transfer_inflight_bytes")),
+            "transfer_queue_depth": int(g.gauge_value(
+                "makisu_transfer_queue_depth")),
         }
+
+    def server_close(self) -> None:
+        from makisu_tpu.utils import events
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        events.remove_global_sink(self._recorder_sink)
+        super().server_close()
 
     def _shared_path_locks(self, argv: list[str]) -> list:
         """Locks for this build's --root/--storage dirs (created on

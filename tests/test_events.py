@@ -46,6 +46,57 @@ def test_sinks_stack_and_raising_sink_is_swallowed():
     assert len(seen) == 1
 
 
+def test_raising_sink_counts_drop():
+    """A swallowed sink failure must be visible: the drop lands in
+    makisu_events_dropped_total (labeled by event type), so a lossy
+    event log is detectable from /metrics."""
+    from makisu_tpu.utils import metrics
+
+    g = metrics.global_registry()
+    before = g.counter_total("makisu_events_dropped_total",
+                             event_type="chunk_fetch")
+
+    def bad_sink(event):
+        raise RuntimeError("dead sink")
+
+    token = events.add_sink(bad_sink)
+    try:
+        events.emit("chunk_fetch", route="pack")
+        events.emit("chunk_fetch", route="blob")
+    finally:
+        events.reset_sink(token)
+    after = g.counter_total("makisu_events_dropped_total",
+                            event_type="chunk_fetch")
+    assert after == before + 2
+
+
+def test_global_sink_sees_every_context_and_removes():
+    """A global sink observes events from bare threads (no context
+    copy) — the worker's process-level flight recorder relies on it —
+    and remove_global_sink detaches it (bound-method equality)."""
+    seen = []
+    sink = seen.append
+    events.add_global_sink(sink)
+    try:
+        bare = threading.Thread(
+            target=lambda: events.emit("global_probe"))
+        bare.start()
+        bare.join()
+    finally:
+        events.remove_global_sink(sink)
+    events.emit("after_removal")
+    assert [e["type"] for e in seen] == ["global_probe"]
+
+
+def test_emit_stamps_progress_clock():
+    before = events.last_emit_monotonic()
+    events.emit("tick")  # no sink bound: still stamps
+    assert events.last_emit_monotonic() >= before
+    mark = events.last_emit_monotonic()
+    events.note_progress()
+    assert events.last_emit_monotonic() >= mark
+
+
 def test_sink_is_context_scoped():
     """A sink bound in one context must be invisible to a bare thread
     (no copy_context) — the isolation that keeps concurrent worker

@@ -50,6 +50,10 @@ def test_build_metrics_out_smoke(tmp_path, out_dir):
         "--metrics-out", str(report_path),
         "--events-out", str(events_path),
         "--trace-out", str(trace_path),
+        # Forensics armed like production CI: a wedged smoke build
+        # dumps a bundle into $MAKISU_TPU_DIAG_DIR (uploaded as an
+        # artifact on failure) instead of dying silently.
+        "--stall-timeout", "300",
         "build", str(ctx), "-t", "smoke/metrics:1",
         "--storage", str(tmp_path / "storage"),
         "--root", str(tmp_path / "root"),
@@ -112,6 +116,28 @@ def test_build_metrics_out_smoke(tmp_path, out_dir):
     assert len(path) >= 3
     total_self = sum(traceexport.self_time_by_name(report).values())
     assert total_self == pytest.approx(durs[0], rel=0.05)
+
+
+def test_failure_bundle_doctor_smoke(tmp_path, monkeypatch, capsys):
+    """Forensics smoke: a failing build with $MAKISU_TPU_DIAG_DIR set
+    (as CI sets it) leaves a diagnostic bundle, and `makisu-tpu
+    doctor` renders a diagnosis from it — the same path a red CI run's
+    uploaded artifact goes through."""
+    diag_dir = tmp_path / "diag"
+    monkeypatch.setenv("MAKISU_TPU_DIAG_DIR", str(diag_dir))
+    code = cli.main(["build", str(tmp_path / "missing-ctx"),
+                     "-t", "smoke/fail:1",
+                     "--storage", str(tmp_path / "fstorage"),
+                     "--root", str(tmp_path / "froot")])
+    assert code == 1
+    [bundle_path] = diag_dir.glob("makisu-tpu-diag-*-failure.json")
+    with open(bundle_path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["schema"] == "makisu-tpu.flightrecorder.v1"
+    assert bundle["reason"] == "failure"
+    assert bundle["threads"]
+    assert cli.main(["doctor", str(bundle_path)]) == 0
+    assert "diagnosis:" in capsys.readouterr().out
 
 
 def test_pull_transfer_smoke(tmp_path, out_dir):

@@ -1,0 +1,90 @@
+"""Resource sampler: /proc readings, gauge publication, span
+attribution, and the report's per-phase resource section."""
+
+import time
+
+from makisu_tpu.utils import metrics, resources, traceexport
+
+
+def test_read_sample_shape():
+    sample = resources.read_sample()
+    assert sample["rss_bytes"] > 0
+    assert sample["cpu_seconds"] > 0
+    assert sample["threads"] >= 1
+    # Linux CI/dev hosts have procfs; these fields must be present
+    # there (they degrade away only on exotic hosts).
+    assert sample.get("open_fds", 1) >= 1
+
+
+def test_sampler_publishes_gauges_and_trajectory():
+    sampler = resources.ResourceSampler(interval=60)  # manual ticks
+    sampler.sample_once()
+    sampler.sample_once()
+    assert len(sampler.trajectory()) == 2
+    g = metrics.global_registry()
+    assert g.gauge_value("makisu_process_rss_bytes") > 0
+    assert g.gauge_value("makisu_process_cpu_seconds") > 0
+    assert g.gauge_value("makisu_process_threads") >= 1
+
+
+def test_samples_attribute_to_open_spans():
+    """Open spans record peak RSS; CPU burned between samples charges
+    the open leaf. Closed spans carry the result in to_dict()."""
+    resources.stop()  # the process singleton must not race the asserts
+    sampler = resources.ResourceSampler(interval=60)
+    registry = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(registry)
+    try:
+        with metrics.span("push_layers") as outer:
+            with metrics.span("hash_batch") as inner:
+                sampler.sample_once()
+                # Burn measurable CPU between the two samples.
+                t0 = time.process_time()
+                while time.process_time() - t0 < 0.05:
+                    sum(i * i for i in range(10_000))
+                sampler.sample_once()
+    finally:
+        metrics.reset_build_registry(token)
+    for span in (outer, inner):
+        d = span.to_dict()
+        assert d["resources"]["peak_rss_bytes"] > 0
+    # The leaf (inner) got the CPU charge, not the parent.
+    assert inner.to_dict()["resources"]["cpu_seconds"] > 0
+    assert outer.to_dict()["resources"]["cpu_seconds"] == 0
+
+
+def test_span_without_sampling_has_no_resources():
+    with metrics.span("quick") as s:
+        pass
+    assert "resources" not in s.to_dict()
+
+
+def test_report_renders_resources_by_phase():
+    report = {
+        "schema": "makisu-tpu.metrics.v1",
+        "spans": [{
+            "name": "build", "span_id": "aa", "start": 100.0,
+            "duration": 2.0,
+            "resources": {"peak_rss_bytes": 64 << 20,
+                          "cpu_seconds": 0.5},
+            "children": [{
+                "name": "push_layers", "span_id": "bb",
+                "start": 100.5, "duration": 1.0,
+                "resources": {"peak_rss_bytes": 128 << 20,
+                              "cpu_seconds": 0.25},
+            }],
+        }],
+    }
+    by_phase = traceexport.resources_by_phase(report)
+    assert by_phase["push"]["peak_rss_bytes"] == 128 << 20
+    assert by_phase["other"]["cpu_seconds"] == 0.5
+    text = traceexport.render_report(report)
+    assert "resource usage by phase" in text
+    assert "128.0MiB" in text
+
+
+def test_ensure_started_is_idempotent():
+    first = resources.ensure_started(interval=30)
+    second = resources.ensure_started(interval=1)
+    assert first is second
+    resources.stop()

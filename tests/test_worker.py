@@ -354,6 +354,12 @@ def test_healthz(tmp_path, worker):
     assert before["status"] == "ok"
     assert before["uptime_seconds"] >= 0
     assert before["active_builds"] == 0
+    # Failure-forensics vitals: the progress clock and the transfer
+    # engine's gauges ride /healthz so a wedged worker is diagnosable
+    # without scraping /metrics.
+    assert before["last_progress_seconds"] >= 0
+    assert before["transfer_inflight_bytes"] >= 0
+    assert before["transfer_queue_depth"] >= 0
 
     ctx = tmp_path / "hctx"
     ctx.mkdir()
@@ -376,6 +382,34 @@ def test_healthz(tmp_path, worker):
     assert after["builds_failed"] == before["builds_failed"] + 1
     assert after["active_builds"] == 0
     assert after["uptime_seconds"] >= before["uptime_seconds"]
+    # The builds just emitted events/logs: the progress clock is fresh.
+    assert after["last_progress_seconds"] < 30
+    # Transfers all settled: nothing reserved or queued.
+    assert after["transfer_inflight_bytes"] == 0
+    assert after["transfer_queue_depth"] == 0
+
+
+def test_worker_process_recorder_captures_builds(tmp_path, worker):
+    """The worker's process-level flight recorder (a global event
+    sink) sees every build's events, so a SIGTERM'd worker can dump a
+    bundle covering all in-flight work."""
+    ctx = tmp_path / "frctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text("FROM scratch\nCOPY f /f\n")
+    (ctx / "f").write_text("x")
+    (tmp_path / "frroot").mkdir()
+    client = WorkerClient(worker.socket_path)
+    assert client.build(["build", str(ctx), "-t", "worker/fr:1",
+                         "--storage", str(tmp_path / "frstorage"),
+                         "--root", str(tmp_path / "frroot")]) == 0
+    bundle = worker.recorder.bundle("inspect")
+    types = [e["type"] for e in bundle["events"]]
+    assert "build_start" in types and "build_end" in types
+    assert bundle["schema"] == "makisu-tpu.flightrecorder.v1"
+    # Process bundle resolves the GLOBAL registry's trace id.
+    from makisu_tpu.utils import metrics
+    assert bundle["build"]["trace_id"] == \
+        metrics.global_registry().trace_id
 
 
 def test_worker_survives_systemexit_with_message(tmp_path, worker):
