@@ -104,6 +104,16 @@ class ChunkStore:
                 max_entries = 1 << 20  # cache sizing never fails builds
         self.cas = CASStore(root, max_entries)
         self.registry = None  # attach via set_remote()
+        # Fingerprint-streamed existence memo (note_fingerprint): the
+        # commit pipeline reports each chunk digest as it is hashed,
+        # and the dedup lookup (one CAS stat per chunk — a 500k-stat
+        # storm on a 4GB layer) runs on the commit pool DURING the
+        # commit instead of serially inside index_layer afterwards.
+        import threading
+        self._exists_memo: dict[str, bool] = {}
+        self._probe_queue: list[str] = []
+        self._memo_gen = 0  # bumped by reset; stale probes discard
+        self._memo_lock = threading.Lock()
 
     def set_remote(self, layer_client) -> None:
         """Attach a registry client; chunk blobs transfer straight into
@@ -137,6 +147,81 @@ class ChunkStore:
         if self.registry is not None:
             return self._fetch_remote(hex_digest)
         return False
+
+    # -- streaming existence prefetch ---------------------------------
+
+    # Digests per pooled probe task: one task per chunk would
+    # reintroduce the per-chunk submission overhead the commit
+    # pipeline just removed (a 4GB layer is ~500k chunks).
+    PROBE_BATCH = 256
+
+    def note_fingerprint(self, hex_digest: str) -> None:
+        """Chunk-fingerprint observer (chunker.cdc.set_chunk_observer):
+        called from the commit pipeline as each chunk digest resolves.
+        Existence stats batch onto the commit pool and memoize for
+        index_layer; a tail shorter than PROBE_BATCH simply never
+        probes (advisory — _exists_cached falls back to the stat).
+        Thread-safe, never raises."""
+        with self._memo_lock:
+            if hex_digest in self._exists_memo:
+                return
+            self._exists_memo[hex_digest] = False  # claimed; stat fills
+            self._probe_queue.append(hex_digest)
+            if len(self._probe_queue) < self.PROBE_BATCH:
+                return
+            batch, self._probe_queue = self._probe_queue, []
+            gen = self._memo_gen
+
+        def probe(batch=batch, gen=gen) -> None:
+            hits = []
+            for h in batch:
+                try:
+                    if self.cas.exists(h):
+                        hits.append(h)
+                except Exception:  # noqa: BLE001 - advisory stat
+                    return
+            with self._memo_lock:
+                if self._memo_gen != gen:
+                    # reset_fingerprint_memo ran while this batch was
+                    # queued: its Trues belong to the PREVIOUS window
+                    # and must not repopulate the cleared memo.
+                    return
+                for h in hits:
+                    self._exists_memo[h] = True
+        from makisu_tpu.utils import concurrency
+        # Plain submit (no context copy): the probe touches no
+        # telemetry, and a copy per batch on the hot path buys nothing.
+        concurrency.hash_pool().submit(probe)
+
+    def _exists_cached(self, hex_digest: str,
+                       tally: list | None = None) -> bool:
+        """index_layer's dedup probe: the prefetched memo when the
+        observer saw this digest, else a plain stat. Only a memoized
+        True short-circuits the stat — a prefetch-time miss re-probes,
+        because the commit itself may have stored the chunk since (a
+        digest repeated within one layer). ``tally`` ([hits, probes])
+        accumulates for a caller-side flush: one labeled counter_add
+        per CHUNK is exactly the overhead the commit pipeline removed
+        from the hash path."""
+        with self._memo_lock:
+            hit = self._exists_memo.get(hex_digest)
+        if tally is None:
+            tally = [0, 0]
+        if hit:
+            tally[0] += 1
+            return True
+        tally[1] += 1
+        return self.cas.exists(hex_digest)
+
+    def reset_fingerprint_memo(self) -> None:
+        """Drop the streamed memo. Called after every index_layer
+        (push_cache): a memoized True must not outlive the commit that
+        prefetched it, or CAS eviction between layers could make
+        index_layer skip storing a chunk it no longer holds."""
+        with self._memo_lock:
+            self._exists_memo.clear()
+            self._probe_queue = []
+            self._memo_gen += 1  # in-flight probe batches discard
 
     def push_remote(self, hex_digest: str) -> None:
         if self.registry is not None:
@@ -244,6 +329,7 @@ class ChunkStore:
         memory stays bounded by the largest chunk (multi-GB layers never
         materialize whole)."""
         added: list[str] = []
+        tally = [0, 0]  # [prefetch hits, stat probes]; flushed below
         with open(layer_blob_path, "rb") as raw:
             stream = gzip_mod.GzipFile(fileobj=raw, mode="rb")
             pos = 0
@@ -258,7 +344,7 @@ class ChunkStore:
                     raise ValueError(
                         f"layer stream ended at {pos}, chunk needs "
                         f"{offset + length}")
-                if self.cas.exists(hex_digest):
+                if self._exists_cached(hex_digest, tally):
                     continue
                 self.put(hex_digest, data)
                 added.append(hex_digest)
@@ -267,6 +353,12 @@ class ChunkStore:
             # a corrupt blob must fail loudly here, not at reconstitute.
             while stream.read(1 << 20):
                 pass
+        if tally[0]:
+            metrics.counter_add("makisu_chunk_exists_prefetch_total",
+                                tally[0], result="hit")
+        if tally[1]:
+            metrics.counter_add("makisu_chunk_exists_prefetch_total",
+                                tally[1], result="probe")
         return added
 
     def build_packs(self, chunks: list[tuple[int, int, str]],
@@ -796,6 +888,12 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                          cache_id)
             except FileNotFoundError:
                 return
+            finally:
+                # The streamed memo served exactly this commit→index
+                # window; a True must not survive into the next
+                # layer's window (CAS eviction in between would make
+                # index_layer skip a chunk it no longer holds).
+                chunk_store.reset_fingerprint_memo()
             if chunk_store.registry is not None:
                 # Off the build thread, like layer pushes: upload the
                 # chunks this layer introduced, then pin the layer's
@@ -1002,4 +1100,13 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
     manager.materialize = materialize
     manager.open_layer_tar = open_layer_tar
     manager.chunk_store = chunk_store
+    from makisu_tpu.utils import concurrency
+    if concurrency.hash_workers() > 1:
+        # Stream dedup lookups: the commit pipeline reports each chunk
+        # fingerprint as it is hashed (context-scoped — concurrent
+        # worker builds observe only their own chunks), so the
+        # per-chunk CAS stats index_layer needs have already run on
+        # the pool by the time push_cache re-reads the layer.
+        from makisu_tpu.chunker import cdc
+        cdc.set_chunk_observer(chunk_store.note_fingerprint)
     return chunk_store

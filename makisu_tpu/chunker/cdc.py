@@ -34,16 +34,46 @@ SURVEY.md §5 "long-context" mapping.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
+import time
 
 import jax
 import numpy as np
 
 from makisu_tpu.ops import backend as _backend
 from makisu_tpu.ops import gear, sha256
-from makisu_tpu.utils import metrics
+from makisu_tpu.utils import concurrency, metrics
 
 BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
+
+# Chunk bytes accumulated before one pooled SHA task dispatches. Sized
+# for GIL economics, not just task overhead: a pooled task is ONE
+# GIL-released native call (native.sha256_batch), and each task costs
+# ~2 GIL acquisitions (entry + return) that can each wait a full
+# 5ms switch interval behind the GIL-bound producer thread — so
+# batches must be big enough that hashing time dwarfs handoff time.
+SHA_BATCH_BYTES = 1024 * 1024
+
+# Fingerprint observer: the chunk-dedup cache registers a callback per
+# build (cache/chunks.attach_chunk_dedup) and CAS-existence lookups
+# issue as fingerprints stream out of the hash stage, instead of as a
+# serial stat storm after finish(). Context-scoped like the metrics
+# registry so concurrent worker builds never observe each other's
+# chunks. Observers must be thread-safe and non-raising: they are
+# called from pool workers on the commit hot path.
+_chunk_observer: "contextvars.ContextVar" = contextvars.ContextVar(
+    "makisu_chunk_observer", default=None)
+
+
+def set_chunk_observer(cb):
+    """Bind a per-context fingerprint callback ``cb(hex_digest)``.
+    Returns a token for :func:`reset_chunk_observer`."""
+    return _chunk_observer.set(cb)
+
+
+def reset_chunk_observer(token) -> None:
+    _chunk_observer.reset(token)
 
 
 def _native_cpu_route() -> bool:
@@ -61,6 +91,18 @@ def _native_cpu_route() -> bool:
         return False
     from makisu_tpu import native
     return native.gear_scan_available()
+
+
+def _sha_batch_route() -> bool:
+    """Whether the pooled multicore route can engage: it needs the
+    native batch hasher (libgear.so gear_sha256_batch — one
+    GIL-released call per ~MiB batch). Per-chunk hashlib on pool
+    threads is NOT a substitute: at ~8KiB chunk sizes the GIL
+    ping-pong against the producer thread scales negatively (measured
+    0.6x on 2 cores), so without the symbol the session stays
+    serial."""
+    from makisu_tpu import native
+    return native.sha_batch_available()
 
 # Lane-buffer buckets: (capacity, lanes). Chunk avg is 8 KiB and max
 # 64 KiB, so most chunks hash in the 16 KiB bucket; each bucket is one
@@ -140,7 +182,8 @@ class ChunkSession:
     def __init__(self, avg_bits: int = gear.DEFAULT_AVG_BITS,
                  min_size: int = gear.DEFAULT_MIN_SIZE,
                  max_size: int = gear.DEFAULT_MAX_SIZE,
-                 block: int = BLOCK, service=None) -> None:
+                 block: int = BLOCK, service=None,
+                 workers: int | None = None) -> None:
         if block % 32:
             raise ValueError("block size must be a multiple of 32")
         # Optional chunker.service.HashService: concurrent builds in one
@@ -162,6 +205,13 @@ class ChunkSession:
         self._batchers = [_LaneBatcher(cap, lanes)
                           for cap, lanes in _BUCKETS]
         self._chunks: list[Chunk] = []
+        # Pooled-route state, defaulted before the backend probe below
+        # (whose _degrade clears them). The batch buffer is assembled
+        # on the producer thread (which owns the GIL anyway); worker
+        # tasks are a single GIL-released native call.
+        self._sha_buf = bytearray()
+        self._sha_meta: list[tuple[int, int]] = []  # (offset, length)
+        self._sha_pending: list = []  # ordered (meta, Future->digests)
         self._degraded: str | None = None  # failure summary once degraded
         # Hang guard: a wedged TPU tunnel makes the first dispatch block
         # forever in backend init, which no exception handler can catch.
@@ -182,6 +232,39 @@ class ChunkSession:
         # The gear table is deterministic by contract; one copy per
         # session, not one 256-iteration rebuild per 4MiB block.
         self._table = gear.gear_table() if self._native else None
+        self._observer = _chunk_observer.get()
+        # Bytes hashed on the native route, accumulated locally and
+        # flushed once at finish(): a per-chunk counter_add (lock +
+        # label sort, ×2 registries) measured ~13% of the whole native
+        # session.
+        self._native_hashed = 0
+        # Multicore native route (the tentpole): gear block scans and
+        # chunk SHA-256 run on the shared commit pool, with results
+        # consumed in stream order so boundaries, digests, and chunk
+        # ordering are byte-identical to the serial route. workers=1
+        # is exactly the serial pipeline.
+        self._workers = 1
+        self._depth = self.PIPELINE_DEPTH
+        self._pool = None
+        self._sha_slots = None
+        if self._native:
+            self._workers = (concurrency.hash_workers()
+                             if workers is None else max(1, workers))
+            if self._workers > 1 and _sha_batch_route():
+                import threading
+                self._pool = concurrency.hash_pool()
+                # Scan deep enough that every worker can hold a block.
+                self._depth = max(self.PIPELINE_DEPTH, self._workers)
+                # Backpressure AND concurrency bound: at most `workers`
+                # SHA batches in flight, so one session never runs more
+                # simultaneous tasks than its configured parallelism on
+                # the shared pool (oversubscription measured as a 3x
+                # LOSS: 8 tasks + the producer thrashing 2 cores), and
+                # resident batch bytes stay ≤ workers × SHA_BATCH_BYTES.
+                self._sha_slots = threading.BoundedSemaphore(
+                    self._workers)
+                self._sha_depth = 0
+                self._sha_depth_lock = threading.Lock()
 
     # -- failure discipline ----------------------------------------------
 
@@ -208,6 +291,12 @@ class ChunkSession:
         self._inflight = []
         self._chunks = []
         self._service_pending = []
+        # Pooled-route state: pending tasks complete harmlessly on the
+        # shared pool (they release their own slots); just drop the
+        # references so their buffers free.
+        self._sha_buf = bytearray()
+        self._sha_meta = []
+        self._sha_pending = []
         for b in self._batchers:
             b.meta = []
             b.pending = []
@@ -253,6 +342,14 @@ class ChunkSession:
             self._tail.clear()
         if self._degraded is None:
             try:
+                if self._pool is not None:
+                    self._flush_sha_batch()
+                    for meta, fut in self._sha_pending:
+                        digests = fut.result()
+                        self._chunks.extend(
+                            Chunk(off, n, digests[i].tobytes())
+                            for i, (off, n) in enumerate(meta))
+                    self._sha_pending = []
                 for b in self._batchers:
                     self._chunks.extend(b.drain())
                 _t = _backend.sync_timeout()
@@ -265,6 +362,19 @@ class ChunkSession:
                               fut.result(timeout=svc_timeout)))
             except Exception as e:  # noqa: BLE001 - device plane
                 self._degrade("lane hashing", e)
+        if self._native_hashed:
+            # One flush for the whole stream (a per-chunk counter_add
+            # measured ~13% of the native session); degraded sessions
+            # still record the bytes they DID hash.
+            metrics.counter_add("makisu_bytes_hashed_total",
+                                self._native_hashed,
+                                backend="native", path="cdc")
+            self._native_hashed = 0
+        if self._pool is not None:
+            # The session is drained: a long-lived worker's /metrics
+            # must not keep showing the last submit-time backlog.
+            metrics.stage_queue_depth("gear_scan", 0)
+            metrics.stage_queue_depth("chunk_sha", 0)
         if self._degraded is not None:
             return []
         self._service_pending = []
@@ -274,26 +384,36 @@ class ChunkSession:
     # -- internals --------------------------------------------------------
 
     def _dispatch_block(self, blk: bytes, live: int | None = None) -> None:
-        """Ship one block to the device (async); process the oldest
+        """Ship one block to the scan stage (device dispatch, or the
+        commit pool on the multicore native route); process the oldest
         in-flight block when the pipeline is full."""
         from makisu_tpu.ops import gear_pallas
         live = len(blk) if live is None else live
         halo = self._halo
-        buf = np.frombuffer(halo + blk, dtype=np.uint8)
         entry = None
         scan_backend = None  # executing backend when != entry[0]'s tag
         if self._native:
-            # Synchronous by design: the scan is faster than a device
-            # round trip, so there is nothing to overlap. The C++ scan
-            # returns candidate POSITIONS directly — no bit array, no
-            # host-side nonzero rescan.
-            from makisu_tpu import native
-            pos = native.gear_scan_positions(
-                buf, self._table, (1 << self.avg_bits) - 1)
-            lo = np.searchsorted(pos, len(halo))
-            hi = np.searchsorted(pos, len(halo) + live)
-            entry = ("native", pos[lo:hi] - len(halo), None,
-                     live, blk, self._scanned)
+            if self._pool is not None:
+                # Pooled scan: each block's candidates are a pure
+                # function of (halo, block) — the same inputs the
+                # synchronous scan sees — so blocks scan in parallel
+                # across the pool while _process_block consumes results
+                # in stream order. Boundaries are byte-identical.
+                fut = concurrency.submit_ctx(
+                    self._pool, self._scan_task, halo, blk, live)
+                entry = ("native", fut, None, live, blk, self._scanned)
+                metrics.stage_queue_depth("gear_scan",
+                                          len(self._inflight) + 1)
+            else:
+                # Synchronous by design: the scan is faster than a
+                # device round trip, so there is nothing to overlap.
+                # The C++ scan returns candidate POSITIONS directly —
+                # no bit array, no host-side nonzero rescan.
+                entry = ("native",
+                         self._scan_positions(halo, blk, live), None,
+                         live, blk, self._scanned)
+        if entry is None:
+            buf = np.frombuffer(halo + blk, dtype=np.uint8)
         if entry is None and gear_pallas.v2_enabled():
             # Opt-in natural-layout kernel (MAKISU_TPU_PALLAS_V2=1):
             # pure-reshape staging, full-buffer bitmap (XLA-contract
@@ -343,14 +463,43 @@ class ChunkSession:
                             backend=scan_backend)
         self._inflight.append(entry)
         self._scanned += live
-        self._halo = (halo + blk)[-(gear_pallas.HALO):]
-        while len(self._inflight) > self.PIPELINE_DEPTH:
+        # Next block's halo, computed without re-concatenating the
+        # whole 4MiB buffer (byte-identical to (halo+blk)[-HALO:]).
+        if len(blk) >= gear_pallas.HALO:
+            self._halo = blk[-gear_pallas.HALO:]
+        else:
+            self._halo = (halo + blk)[-(gear_pallas.HALO):]
+        while len(self._inflight) > self._depth:
             self._process_block(self._inflight.pop(0))
+
+    def _scan_positions(self, halo: bytes, blk: bytes, live: int):
+        """Candidate positions for one block (native C++ scan): the
+        shared math of the synchronous and pooled routes — positions
+        over halo+blk, trimmed to the live region, halo-relative."""
+        from makisu_tpu import native
+        buf = np.frombuffer(halo + blk, dtype=np.uint8)
+        pos = native.gear_scan_positions(
+            buf, self._table, (1 << self.avg_bits) - 1)
+        lo = np.searchsorted(pos, len(halo))
+        hi = np.searchsorted(pos, len(halo) + live)
+        return pos[lo:hi] - len(halo)
+
+    def _scan_task(self, halo: bytes, blk: bytes, live: int):
+        t0 = time.monotonic()
+        try:
+            return self._scan_positions(halo, blk, live)
+        finally:
+            metrics.stage_busy_add("gear_scan", time.monotonic() - t0)
 
     def _process_block(self, entry: tuple) -> None:
         """Read back one block's bitmap (bounded sync) and cut chunks."""
         kind, words, meta, live, blk, base = entry
         if kind == "native":
+            if hasattr(words, "result"):
+                # Pooled scan: block until THIS block's candidates are
+                # in (stream order preserved; a task error propagates
+                # here and degrades the session like any scan failure).
+                words = words.result()
             candidates = words.astype(np.int64) + base  # host positions
         elif kind == "pallas":
             from makisu_tpu.ops import gear_pallas
@@ -369,8 +518,10 @@ class ChunkSession:
                 host_words, halo_len + live)[halo_len:halo_len + live]
             candidates = np.nonzero(bits)[0] + base
         self._tail.extend(blk[:live])
-        for pos in candidates:
-            self._cut_to(int(pos) + 1)  # cut AFTER the boundary byte
+        # tolist(): one C conversion instead of a numpy-scalar __int__
+        # per candidate on the producer's critical path.
+        for pos in candidates.tolist():
+            self._cut_to(pos + 1)  # cut AFTER the boundary byte
         # Oversize tail without candidates: force max-size cuts.
         while len(self._tail) > self.max_size:
             self._force_cut(self._tail_offset + self.max_size)
@@ -390,21 +541,95 @@ class ChunkSession:
         n = end - self._tail_offset
         if n <= 0:
             return
-        data = bytes(self._tail[:n])
-        del self._tail[:n]
-        self._emit(data, self._tail_offset)
+        if self._pool is not None and self._degraded is None:
+            # Pooled fast path: chunk bytes copy ONCE, straight from
+            # the tail into the batch buffer (the generic path below
+            # would copy twice more — slice, then bytes()). The
+            # memoryview must close before the del: a bytearray with
+            # an exported buffer cannot resize.
+            with memoryview(self._tail) as mv:
+                self._sha_buf += mv[:n]
+            del self._tail[:n]
+            self._native_hashed += n
+            self._sha_meta.append((self._tail_offset, n))
+            if len(self._sha_buf) >= SHA_BATCH_BYTES:
+                self._flush_sha_batch()
+        else:
+            with memoryview(self._tail) as mv:
+                data = bytes(mv[:n])
+            del self._tail[:n]
+            self._emit(data, self._tail_offset)
         self._tail_offset = end
         self._prev_cut = end
+
+    def _notify(self, hex_digest: str) -> None:
+        """Stream one fingerprint to the bound observer (chunk-dedup
+        cache prefetch). Never raises: a cache-side hiccup must not
+        degrade fingerprinting."""
+        if self._observer is None:
+            return
+        try:
+            self._observer(hex_digest)
+        except Exception:  # noqa: BLE001 - observer plane
+            self._observer = None  # one failure disables, not N
+
+    def _flush_sha_batch(self) -> None:
+        if not self._sha_meta:
+            return
+        buf = self._sha_buf  # zero-copy handoff; fresh buffer below
+        meta = self._sha_meta
+        self._sha_buf = bytearray()
+        self._sha_meta = []
+        self._sha_slots.acquire()  # released by the task (backpressure)
+        with self._sha_depth_lock:
+            self._sha_depth += 1
+            depth = self._sha_depth
+        metrics.stage_queue_depth("chunk_sha", depth)
+        self._sha_pending.append(
+            (meta, concurrency.submit_ctx(self._pool, self._sha_task,
+                                          buf, [n for _, n in meta])))
+
+    def _sha_task(self, buf: bytes, lengths: list[int]):
+        """Pool-side chunk hashing: ONE GIL-released native call for
+        the whole batch (digests byte-identical to hashlib — same
+        OpenSSL underneath). Deliberately does nothing else: every
+        extra GIL acquisition on a pool thread can stall a full switch
+        interval behind the GIL-bound producer, so batch assembly
+        happens in _emit and Chunk objects are built at finish()."""
+        from makisu_tpu import native
+        t0 = time.monotonic()
+        try:
+            digests = native.sha256_batch(buf, lengths)
+            if self._observer is not None:
+                for row in digests:
+                    self._notify(row.tobytes().hex())
+            return digests
+        finally:
+            with self._sha_depth_lock:
+                self._sha_depth -= 1
+            self._sha_slots.release()
+            metrics.stage_busy_add("chunk_sha", time.monotonic() - t0)
 
     def _emit(self, data: bytes, offset: int) -> None:
         if self._native:
             # hashlib IS the native SHA-256 (OpenSSL, SHA-NI): no lane
-            # batching to amortize on a CPU host.
+            # batching to amortize on a CPU host. Bytes-hashed totals
+            # accumulate locally and flush once at finish().
+            self._native_hashed += len(data)
+            if self._pool is not None:
+                # Multicore route: chunk bytes accumulate into one
+                # contiguous batch buffer and hash on the pool;
+                # finish() drains the futures in submit (= stream)
+                # order.
+                self._sha_buf += data
+                self._sha_meta.append((offset, len(data)))
+                if len(self._sha_buf) >= SHA_BATCH_BYTES:
+                    self._flush_sha_batch()
+                return
             import hashlib
-            metrics.counter_add("makisu_bytes_hashed_total", len(data),
-                                backend="native", path="cdc")
-            self._chunks.append(
-                Chunk(offset, len(data), hashlib.sha256(data).digest()))
+            digest = hashlib.sha256(data).digest()
+            self._chunks.append(Chunk(offset, len(data), digest))
+            self._notify(digest.hex())
             return
         if self.service is not None:
             self._service_pending.append(

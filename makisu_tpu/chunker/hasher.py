@@ -64,6 +64,7 @@ class LayerSink:
         import os as _os
         self._tar_digest = hashlib.sha256()
         self._nbytes = 0  # uncompressed bytes digested (telemetry)
+        self._writes = 0  # queue-depth sampling stride
         self._tee = tario.TeeDigest(out)
         self.backend_id = backend_id or tario.gzip_backend_id()
         self._gz = tario.gzip_writer(self._tee, backend_id=self.backend_id)
@@ -74,22 +75,38 @@ class LayerSink:
         self._worker = None
         self._worker_error: list[BaseException] = []
         if threaded:
+            import contextvars
             import queue
             import threading
+            import time as _time
             self._queue = queue.Queue(maxsize=8)
 
             def run() -> None:
-                while True:
-                    item = self._queue.get()
-                    if item is None:
-                        return
-                    try:
-                        self._gz.write(item)
-                    except BaseException as e:  # noqa: BLE001
-                        self._worker_error.append(e)
-                        return
+                # Busy time accumulates locally and flushes once at
+                # stream end — per-write counter churn would become
+                # the overhead it measures.
+                busy = 0.0
+                try:
+                    while True:
+                        item = self._queue.get()
+                        if item is None:
+                            return
+                        t0 = _time.monotonic()
+                        try:
+                            self._gz.write(item)
+                        except BaseException as e:  # noqa: BLE001
+                            self._worker_error.append(e)
+                            return
+                        busy += _time.monotonic() - t0
+                finally:
+                    metrics.stage_busy_add("compress", busy)
 
-            self._worker = threading.Thread(target=run, daemon=True)
+            # copy_context: the stage counter must land in the build's
+            # registry, not just the process-global one (threads start
+            # with an empty context).
+            self._worker = threading.Thread(
+                target=contextvars.copy_context().run, args=(run,),
+                daemon=True)
             self._worker.start()
 
     def _put_checked(self, item) -> None:
@@ -119,6 +136,10 @@ class LayerSink:
             # as-is: a per-write copy on the layer hot path.
             self._put_checked(data if isinstance(data, bytes)
                               else bytes(data))
+            self._writes += 1
+            if not self._writes & 0xFF:  # sampled: writes are ~16KiB
+                metrics.stage_queue_depth("compress",
+                                          self._queue.qsize())
         self._tar_digest.update(data)
         self._nbytes += len(data)
         if self._queue is None:

@@ -32,7 +32,6 @@ from makisu_tpu.utils import metrics
 _FILL_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0)
 
-
 class HashService:
     """Cross-build chunk-hash batcher. Thread-safe; one per process."""
 
@@ -41,7 +40,13 @@ class HashService:
     # accumulating host memory without bound.
     QUEUE_DEPTH_BATCHES = 2
 
-    def __init__(self, linger_seconds: float = 0.002) -> None:
+    def __init__(self, linger_seconds: float | None = None) -> None:
+        if linger_seconds is None:
+            # --hash-linger-ms / MAKISU_TPU_HASH_LINGER_MS (2ms
+            # default); utils.concurrency owns the knob so the CLI can
+            # read it without importing the device stack.
+            from makisu_tpu.utils import concurrency
+            linger_seconds = concurrency.hash_linger_ms() / 1000.0
         self.linger = linger_seconds
         self._queues: list[queue.Queue] = [
             queue.Queue(maxsize=lanes * self.QUEUE_DEPTH_BATCHES)

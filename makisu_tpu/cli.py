@@ -52,6 +52,20 @@ def make_parser() -> argparse.ArgumentParser:
                         help="cap on transfer bytes resident in memory at "
                              "once, across all parallel transfers "
                              "(default 256)")
+    parser.add_argument("--hash-workers", type=int, default=0,
+                        metavar="N",
+                        help="layer-commit pipeline workers: file "
+                             "read-ahead, parallel gear block scans, "
+                             "and pooled chunk SHA-256 overlap on N "
+                             "threads (default min(8, cpu) on >=4-core "
+                             "hosts, serial below; 1 = the serial "
+                             "pipeline; env MAKISU_TPU_HASH_WORKERS)")
+    parser.add_argument("--hash-linger-ms", type=float, default=-1.0,
+                        metavar="MS",
+                        help="shared hash-service batch linger in "
+                             "milliseconds (worker-mode cross-build "
+                             "device batching; default 2; env "
+                             "MAKISU_TPU_HASH_LINGER_MS)")
     parser.add_argument("--metrics-out", default="", metavar="FILE",
                         help="write a JSON telemetry report (span tree + "
                              "counters) for this command to FILE")
@@ -131,9 +145,12 @@ def make_parser() -> argparse.ArgumentParser:
     build.add_argument("--compression", default="default",
                        choices=sorted(tario.COMPRESSION_LEVELS))
     build.add_argument("--gzip-backend", default="zlib",
-                       choices=["zlib", "pgzip"],
-                       help="layer compressor: stdlib zlib or the native "
-                            "parallel block-deflate (native/libpgzip.so)")
+                       choices=["zlib", "pgzip", "auto"],
+                       help="layer compressor: stdlib zlib, the native "
+                            "parallel block-deflate (native/libpgzip.so),"
+                            " or auto (pgzip when the native library is "
+                            "available, else zlib; the RESOLVED backend "
+                            "is what enters cache identity)")
     build.add_argument("--preserve-root", action="store_true",
                        help="save and restore / around the build")
     build.add_argument("--root", default="/",
@@ -244,8 +261,13 @@ def cmd_build(args) -> int:
                           if args.registry_config else None)
     # Validated per-build compression identity: threaded through the
     # BuildContext rather than tario's process globals, so concurrent
-    # builds in one worker can use different flags.
-    gzip_backend_id = tario.make_backend_id(args.gzip_backend,
+    # builds in one worker can use different flags. `auto` resolves to
+    # a concrete backend HERE (logged once per build) — only concrete
+    # backends enter cache identity.
+    gzip_backend = tario.resolve_backend(args.gzip_backend)
+    if args.gzip_backend == "auto":
+        log.info("gzip backend auto-selected: %s", gzip_backend)
+    gzip_backend_id = tario.make_backend_id(gzip_backend,
                                             args.compression)
     blacklist = list(pathutils.DEFAULT_BLACKLIST)
     for extra in args.blacklist:
@@ -596,6 +618,16 @@ def main(argv: list[str] | None = None) -> int:
         from makisu_tpu.registry import transfer
         transfer.configure(args.transfer_concurrency,
                            args.transfer_memory_budget)
+    hash_workers_token = None
+    if args.hash_workers > 0:
+        # Context-scoped (like the metrics registry): concurrent
+        # worker builds can carry different worker counts.
+        hash_workers_token = concurrency.set_hash_workers(
+            args.hash_workers)
+    if args.hash_linger_ms >= 0:
+        # Process-wide by design: the hash service batches ACROSS
+        # builds, so there is one linger per process.
+        concurrency.set_hash_linger_ms(args.hash_linger_ms)
     if args.command == "version":
         print(makisu_tpu.BUILD_HASH)
         return 0
@@ -635,7 +667,9 @@ def main(argv: list[str] | None = None) -> int:
         command=args.command or "",
         hasher=getattr(args, "hasher", "") or "",
         platform=os.environ.get("JAX_PLATFORMS", "") or "default",
-        mode=invocation_mode.get())
+        mode=invocation_mode.get(),
+        hash_workers=concurrency.hash_workers(),
+        hash_linger_ms=concurrency.hash_linger_ms())
     # Failure forensics: every invocation arms a flight recorder (a
     # lock-free ring of recent events/log records) and the process
     # resource sampler. Cost when nothing goes wrong: one deque append
@@ -744,6 +778,8 @@ def main(argv: list[str] | None = None) -> int:
         flightrecorder.uninstall(recorder_tokens)
         events.reset_progress_cell(progress_token)
         metrics.reset_build_registry(metrics_token)
+        if hash_workers_token is not None:
+            concurrency.reset_hash_workers(hash_workers_token)
         if jax_trace:
             import jax
             jax.profiler.stop_trace()

@@ -133,10 +133,11 @@ def layersink_available() -> bool:
 
 _gear_lib: ctypes.CDLL | None = None
 _gear_failed = False
+_gear_sha_batch = False
 
 
 def _load_gear() -> ctypes.CDLL | None:
-    global _gear_lib, _gear_failed
+    global _gear_lib, _gear_failed, _gear_sha_batch
     with _lock:
         if _gear_lib is not None or _gear_failed:
             return _gear_lib
@@ -159,11 +160,59 @@ def _load_gear() -> ctypes.CDLL | None:
             _gear_lib = lib
         except (OSError, AttributeError):
             _gear_failed = True
+            return _gear_lib
+        try:
+            # Newer symbol, bound separately: a prebuilt library from
+            # before the batch hasher must still serve gear scans.
+            lib.gear_sha256_batch.restype = ctypes.c_int
+            lib.gear_sha256_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8)]
+            _gear_sha_batch = True
+        except AttributeError:
+            _gear_sha_batch = False
         return _gear_lib
 
 
 def gear_scan_available() -> bool:
     return _load_gear() is not None
+
+
+def sha_batch_available() -> bool:
+    return _load_gear() is not None and _gear_sha_batch
+
+
+def sha256_batch(buf, lengths):
+    """SHA-256 each slice of ``buf`` (slice i covers
+    ``[sum(lengths[:i]), sum(lengths[:i+1]))``); returns an
+    ``np.uint8[count, 32]`` digest array. ONE ctypes call for the whole
+    batch — the GIL is released end to end, which is what lets pooled
+    chunk hashing scale past the per-call GIL ping-pong that per-chunk
+    hashlib suffers at ~8KiB sizes. Digests are byte-identical to
+    hashlib (same OpenSSL via EVP; audited scalar fallback)."""
+    import numpy as np
+
+    lib = _load_gear()
+    if lib is None or not _gear_sha_batch:
+        raise OSError("libgear.so sha256 batch unavailable")
+    lengths64 = np.ascontiguousarray(lengths, dtype=np.uint64)
+    offsets = np.zeros(len(lengths64), dtype=np.uint64)
+    np.cumsum(lengths64[:-1], out=offsets[1:])
+    out = np.empty((len(lengths64), 32), dtype=np.uint8)
+    # frombuffer: zero-copy for bytes AND bytearray (the pooled commit
+    # route hands its batch bytearray straight through).
+    buf_arr = np.frombuffer(buf, dtype=np.uint8)
+    rc = lib.gear_sha256_batch(
+        buf_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths64.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(lengths64),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise RuntimeError("gear_sha256_batch failed")
+    return out
 
 
 def gear_scan_bits(buf, table, mask: int):

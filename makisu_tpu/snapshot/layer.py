@@ -14,10 +14,12 @@ from __future__ import annotations
 import dataclasses
 import os
 import tarfile
+import threading
+import time
 
 from makisu_tpu import tario
 from makisu_tpu.snapshot.walk import WHITEOUT_PREFIX
-from makisu_tpu.utils import pathutils
+from makisu_tpu.utils import concurrency, metrics, pathutils
 
 
 @dataclasses.dataclass
@@ -29,8 +31,9 @@ class ContentEntry:
     dst: str  # logical absolute path; layer key
     hdr: tarfile.TarInfo
 
-    def commit(self, tw: tarfile.TarFile) -> None:
-        tario.write_entry(tw, self.src, self.hdr)
+    def commit(self, tw: tarfile.TarFile,
+               data: bytes | None = None) -> None:
+        tario.write_entry(tw, self.src, self.hdr, data=data)
 
 
 @dataclasses.dataclass
@@ -39,11 +42,114 @@ class WhiteoutEntry:
 
     deleted: str  # logical absolute path being deleted; layer key
 
-    def commit(self, tw: tarfile.TarFile) -> None:
+    def commit(self, tw: tarfile.TarFile,
+               data: bytes | None = None) -> None:
         d, b = os.path.split(self.deleted)
         hdr = tarfile.TarInfo(
             pathutils.rel_path(os.path.join(d, WHITEOUT_PREFIX + b)))
         tw.addfile(hdr)
+
+
+class _ReadAhead:
+    """File read-ahead for the tar writer: upcoming ContentEntry bytes
+    prefetch on the commit pool so the (strictly ordered) writer never
+    blocks on a cold page-cache read.
+
+    Two modes, chosen by the writer:
+
+    - **buffer** (Python tar writers): prefetched bytes are handed to
+      the writer directly — the disk read happens ahead, off-thread.
+    - **warm** (the native ``add_path`` writer, whose C++ read path is
+      faster than a Python bytes hand-off): the task reads and
+      discards, purely to populate the page cache; the writer still
+      streams content in C++.
+
+    Prefetch results are advisory: any read error, or a file whose size
+    changed since its header was recorded, yields ``None`` and the
+    writer falls back to streaming from disk, which surfaces errors
+    through the exact same code path as the serial commit. In-flight
+    bytes are budgeted so a layer of large files can't balloon memory.
+    """
+
+    MAX_FILE_BYTES = 8 * 1024 * 1024   # larger files stream as before
+    BUDGET_BYTES = 64 * 1024 * 1024    # in-flight prefetch cap
+
+    def __init__(self, items: list[tuple[str, "ContentEntry"]],
+                 buffer: bool, workers: int) -> None:
+        self._queue = list(items)  # (key, entry), commit order
+        self._queue.reverse()      # pop() from the front cheaply
+        self._buffer = buffer
+        self._pool = concurrency.hash_pool()
+        # Bounded by TASKS as well as bytes: a layer of 50k tiny files
+        # must not enqueue 50k reads ahead of the SHA/scan stages on
+        # the shared FIFO pool (bulk read-ahead would effectively
+        # serialize hashing behind it).
+        self._max_tasks = max(4 * workers, 8)
+        self._futs: dict[str, tuple] = {}  # key -> (future, size)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._busy = [0.0]  # worker read seconds (flushed at close)
+        self._top_up()
+
+    def _top_up(self) -> None:
+        while (self._queue and self._inflight < self.BUDGET_BYTES
+               and len(self._futs) < self._max_tasks):
+            key, entry = self._queue.pop()
+            size = entry.hdr.size
+            self._inflight += size
+            self._futs[key] = (concurrency.submit_ctx(
+                self._pool, self._read, entry.src, size), size)
+        metrics.stage_queue_depth("read_ahead", len(self._futs))
+
+    def _read(self, src: str, size: int) -> bytes | None:
+        t0 = time.monotonic()
+        try:
+            with open(src, "rb") as f:
+                if not self._buffer:
+                    # Warm mode: touch every page, keep nothing.
+                    while f.read(1 << 20):
+                        pass
+                    return None
+                data = f.read(size + 1)
+        except OSError:
+            return None  # writer re-reads and surfaces the real error
+        finally:
+            with self._lock:
+                self._busy[0] += time.monotonic() - t0
+        # A size change since the scan means the header no longer
+        # matches the content; the streaming path owns that failure
+        # mode (tarfile raises on short reads), so fall back to it.
+        return data if len(data) == size else None
+
+    def take(self, key: str) -> bytes | None:
+        """Prefetched bytes for ``key`` (buffer mode), else None. Tops
+        the pipeline back up as the writer consumes entries. Warm mode
+        never waits: the result is discarded by construction, so
+        blocking the native writer behind a saturated pool for it
+        would make read-ahead a slowdown."""
+        fut, size = self._futs.pop(key, (None, 0))
+        if fut is None:
+            return None
+        self._inflight -= size
+        self._top_up()
+        if not self._buffer:
+            return None  # advisory warm; the task completes on its own
+        try:
+            data = fut.result()
+        except Exception:  # noqa: BLE001 - advisory stage
+            return None
+        return data
+
+    def close(self) -> None:
+        # Cancel what never started: orphaned reads would otherwise
+        # occupy pool slots ahead of the next layer's scan/SHA tasks
+        # (already-running reads finish on their own, harmlessly).
+        for fut, _ in self._futs.values():
+            fut.cancel()
+        self._futs.clear()
+        self._queue = []
+        metrics.stage_busy_add("read_ahead", self._busy[0])
+        metrics.stage_queue_depth("read_ahead", 0)
 
 
 class Layer:
@@ -77,6 +183,31 @@ class Layer:
         self.entries[deleted] = entry
         return entry
 
-    def commit(self, tw: tarfile.TarFile) -> None:
-        for key in sorted(self.entries):
-            self.entries[key].commit(tw)
+    def commit(self, tw: tarfile.TarFile,
+               workers: int | None = None) -> None:
+        """Write entries in sorted path order (cache-identity-bearing).
+        With ``workers > 1`` (default: concurrency.hash_workers), file
+        content prefetches ahead of the writer on the commit pool; the
+        produced tar bytes are identical either way."""
+        keys = sorted(self.entries)
+        if workers is None:
+            workers = concurrency.hash_workers()
+        ra = None
+        if workers > 1:
+            eligible = [
+                (k, e) for k in keys
+                if isinstance(e := self.entries[k], ContentEntry)
+                and e.hdr.isreg()
+                and 0 < e.hdr.size <= _ReadAhead.MAX_FILE_BYTES]
+            if len(eligible) > 1:
+                ra = _ReadAhead(
+                    eligible,
+                    buffer=getattr(tw, "add_path", None) is None,
+                    workers=workers)
+        try:
+            for key in keys:
+                data = ra.take(key) if ra is not None else None
+                self.entries[key].commit(tw, data=data)
+        finally:
+            if ra is not None:
+                ra.close()

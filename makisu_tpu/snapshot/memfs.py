@@ -37,7 +37,7 @@ from makisu_tpu.snapshot.walk import (
     tarinfo_from_stat,
     walk,
 )
-from makisu_tpu.utils import fileio, mountinfo, pathutils
+from makisu_tpu.utils import fileio, metrics, mountinfo, pathutils
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils.fileio import Owner
 
@@ -175,7 +175,16 @@ class MemFS:
         return layer
 
     def _commit_layer(self, layer: Layer, tw: tarfile.TarFile) -> None:
-        layer.commit(tw)
+        # The single funnel for scan and copy-op commits: wall time
+        # here is the tar_write stage of the commit pipeline (the
+        # ordered producer the read-ahead / chunk-SHA / compress
+        # stages overlap) — `makisu-tpu report` ranks the stages to
+        # name the bottleneck.
+        t0 = time.monotonic()  # same clock as every other stage
+        try:
+            layer.commit(tw)
+        finally:
+            metrics.stage_busy_add("tar_write", time.monotonic() - t0)
         self.layers.append(layer)
 
     def _create_layer_by_scan(self) -> Layer:

@@ -121,11 +121,26 @@ def backend_id_usable(backend_id: str | None) -> bool:
     return True
 
 
+def resolve_backend(name: str) -> str:
+    """Resolve the flag-level backend choice to a concrete backend:
+    ``auto`` takes pgzip (parallel block deflate) when
+    native/libpgzip.so is loadable, else zlib. Only CONCRETE backends
+    ever appear in backend-id strings — cache identity records what a
+    blob was actually compressed with, never the policy that chose
+    it."""
+    if name != "auto":
+        return name
+    from makisu_tpu.native import pgzip_available
+    return "pgzip" if pgzip_available() else "zlib"
+
+
 def make_backend_id(backend: str, level_name: str) -> str:
     """Validate a (backend, level) flag pair into a backend id string —
     the per-build compression identity threaded through BuildContext, so
     concurrent builds with different flags never race on the module
-    globals (those remain only as process defaults)."""
+    globals (those remain only as process defaults). Accepts ``auto``
+    (resolved here via resolve_backend)."""
+    backend = resolve_backend(backend)
     _validate_backend(backend)
     if level_name not in COMPRESSION_LEVELS:
         raise ValueError(
@@ -254,14 +269,22 @@ def apply_header(path: str, h: tarfile.TarInfo) -> None:
         os.utime(path, (h.mtime, h.mtime))
 
 
-def write_entry(tw, src: str, h: tarfile.TarInfo) -> None:
+def write_entry(tw, src: str, h: tarfile.TarInfo,
+                data: bytes | None = None) -> None:
     """Write one entry; regular-file content streams from ``src``.
     Writers exposing ``add_path`` (the native pipeline) stream content
-    in C++ without the bytes ever entering Python."""
+    in C++ without the bytes ever entering Python. ``data`` is the
+    read-ahead pool's prefetched content (exactly ``h.size`` bytes,
+    snapshot/layer._ReadAhead): byte-identical to the disk read, minus
+    the cold-cache stall on the writer's thread."""
     if h.isreg() and h.size > 0:
         add_path = getattr(tw, "add_path", None)
         if add_path is not None:
             add_path(h, src)
+            return
+        if data is not None and len(data) == h.size:
+            import io
+            tw.addfile(h, io.BytesIO(data))
             return
         with open(src, "rb") as f:
             tw.addfile(h, f)

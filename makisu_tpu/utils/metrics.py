@@ -43,6 +43,24 @@ _LabelKey = tuple[tuple[str, str], ...]
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    30.0, 60.0)
 
+# The layer-commit pipeline's per-stage telemetry (read_ahead, gear_scan,
+# chunk_sha, compress, tar_write). One name pair shared by every stage —
+# and by the `makisu-tpu report` bottleneck section — so the series can
+# never drift apart.
+COMMIT_STAGE_BUSY = "makisu_commit_stage_busy_seconds"
+COMMIT_QUEUE_DEPTH = "makisu_commit_queue_depth"
+
+
+def stage_busy_add(stage: str, seconds: float) -> None:
+    """Charge ``seconds`` of busy time to one commit-pipeline stage.
+    Callers accumulate locally and flush per batch/close — never per
+    chunk — so the accounting can't become the overhead it measures."""
+    counter_add(COMMIT_STAGE_BUSY, seconds, stage=stage)
+
+
+def stage_queue_depth(stage: str, depth: int) -> None:
+    gauge_set(COMMIT_QUEUE_DEPTH, depth, stage=stage)
+
 
 def _label_key(labels: dict[str, Any]) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))

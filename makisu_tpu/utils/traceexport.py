@@ -234,6 +234,20 @@ def bytes_hashed_by_backend(report: dict) -> dict[str, float]:
     return out
 
 
+def commit_stage_busy(report: dict) -> dict[str, float]:
+    """Busy seconds per layer-commit pipeline stage (tar_write,
+    read_ahead, gear_scan, chunk_sha, compress) — the multicore
+    commit's own breakdown. The busiest stage is the one to attack:
+    it bounds commit throughput no matter how many workers the others
+    get."""
+    from makisu_tpu.utils import metrics
+    out: dict[str, float] = {}
+    for series in _counter_series(report, metrics.COMMIT_STAGE_BUSY):
+        stage = series.get("labels", {}).get("stage", "?")
+        out[stage] = out.get(stage, 0.0) + series.get("value", 0.0)
+    return out
+
+
 # -- the `makisu-tpu report` text ------------------------------------------
 
 
@@ -354,6 +368,16 @@ def render_report(report: dict, event_log: list[dict] | None = None,
                         if total else ""))
     else:
         lines.append("bytes hashed: none recorded")
+
+    stages = commit_stage_busy(report)
+    if stages:
+        lines.append("")
+        lines.append("commit pipeline stages (busy time):")
+        ordered = sorted(stages.items(), key=lambda kv: kv[1],
+                         reverse=True)
+        for i, (stage, busy) in enumerate(ordered):
+            lines.append(f"  {stage:<12s} {busy:9.3f}s"
+                         + ("  ← bottleneck" if i == 0 and busy else ""))
 
     if event_log is not None:
         census: dict[str, int] = {}

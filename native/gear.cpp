@@ -20,6 +20,8 @@
 #include <cstddef>
 #include <cstdint>
 
+#include "sha256_common.h"
+
 namespace {
 
 constexpr size_t kWindow = 32;   // bytes of history in a 32-bit h
@@ -132,6 +134,50 @@ void gear_scan(const uint8_t *data, size_t n, const uint32_t *table,
     if (done < bounds[s + 1])
       scan_range(data, done, bounds[s + 1], table, mask, out);
   }
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Batch SHA-256 over `count` slices of one contiguous buffer:
+// digest i covers data[offsets[i] .. offsets[i]+lengths[i]) and lands
+// at out[32*i]. One call per ~hundreds-of-KiB batch is what makes the
+// commit pipeline's pooled chunk hashing scale: the caller (ctypes)
+// releases the GIL for the WHOLE batch, so worker threads spend
+// microseconds — not the whole batch — contending with the producer.
+// Digests are the same construction the layer sink uses
+// (sha256_common.h: OpenSSL EVP when present, scalar fallback), i.e.
+// byte-identical to hashlib. Returns 0 on success.
+int gear_sha256_batch(const uint8_t *data, const uint64_t *offsets,
+                      const uint64_t *lengths, size_t count,
+                      uint8_t *out) {
+  if (makisu_native::evp().ok) {
+    // One EVP context re-initialized per slice: ctx creation is the
+    // per-digest overhead worth amortizing at ~8KiB chunk sizes.
+    void *ctx = makisu_native::evp().md_ctx_new();
+    if (ctx) {
+      for (size_t i = 0; i < count; ++i) {
+        unsigned int len = 32;
+        if (makisu_native::evp().init(
+                ctx, makisu_native::evp().sha256(), nullptr) != 1 ||
+            makisu_native::evp().update(ctx, data + offsets[i],
+                                        lengths[i]) != 1 ||
+            makisu_native::evp().final(ctx, out + 32 * i, &len) != 1) {
+          makisu_native::evp().md_ctx_free(ctx);
+          return 1;
+        }
+      }
+      makisu_native::evp().md_ctx_free(ctx);
+      return 0;
+    }
+  }
+  for (size_t i = 0; i < count; ++i) {
+    makisu_native::Sha256 d;
+    d.update(data + offsets[i], lengths[i]);
+    d.final(out + 32 * i);
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -1,0 +1,366 @@
+"""Multicore layer-commit pipeline: determinism and stage mechanics.
+
+The tentpole invariant: the pipeline's worker count is a PERFORMANCE
+knob, never an identity knob. Committing the same context with
+``--hash-workers 1`` and ``--hash-workers 8`` must produce identical
+layer tar bytes, identical gzip blobs, identical chunk boundaries, and
+identical ``LayerCommit`` digests — chunk fingerprints are cache keys,
+so any divergence would split the distributed cache by host core
+count.
+
+Also the CI marker for the fastest route: the native gear scan +
+pgzip compression path runs here end to end, so the production-speed
+pipeline is exercised by tier-1, not just the pure-Python fallbacks.
+"""
+
+import contextlib
+import hashlib
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from makisu_tpu import native, tario
+from makisu_tpu.chunker import get_hasher
+from makisu_tpu.chunker.cdc import BLOCK, ChunkSession
+from makisu_tpu.snapshot.layer import Layer, _ReadAhead
+from makisu_tpu.utils import concurrency, metrics
+
+
+@contextlib.contextmanager
+def hash_workers(n):
+    token = concurrency.set_hash_workers(n)
+    try:
+        yield
+    finally:
+        concurrency.reset_hash_workers(token)
+
+
+def _tree(tmp_path, seed=7):
+    """A context with enough content to cross chunk/block boundaries:
+    one multi-MB file (many CDC chunks), a spread of small files (the
+    read-ahead pool's bread and butter), and the tar corner cases."""
+    root = tmp_path / f"tree{seed}"
+    root.mkdir()
+    rnd = np.random.default_rng(seed)
+    (root / "big.bin").write_bytes(
+        rnd.integers(0, 256, size=5_000_000, dtype=np.uint8).tobytes())
+    for i in range(40):
+        (root / f"f{i:02d}.dat").write_bytes(
+            rnd.integers(0, 256, size=3_000 + 731 * i,
+                         dtype=np.uint8).tobytes())
+    (root / "empty").write_bytes(b"")
+    sub = root / "sub"
+    sub.mkdir()
+    (sub / "nested.txt").write_bytes(b"nested content\n")
+    (root / "link").symlink_to("empty")
+    return root
+
+
+def _layer_for(root):
+    from makisu_tpu.snapshot.walk import tarinfo_from_stat, walk
+    from makisu_tpu.utils import pathutils
+    layer = Layer()
+    entries = []
+
+    def one(path, st):
+        if path == str(root):
+            return
+        dst = pathutils.trim_root(path, str(root))
+        hdr = tarinfo_from_stat(path, pathutils.rel_path(dst), str(root))
+        entries.append((path, dst, hdr))
+
+    walk(str(root), None, one)
+    for path, dst, hdr in entries:
+        layer.add_header(path, dst, hdr)
+    return layer
+
+
+def _commit(root, path, backend_id, workers, hasher="tpu"):
+    layer = _layer_for(root)
+    with hash_workers(workers):
+        with open(path, "wb") as out:
+            sink = get_hasher(hasher).open_layer(out,
+                                                 backend_id=backend_id)
+            with sink.open_tar() as tw:
+                layer.commit(tw, workers=workers)
+            return sink.finish()
+
+
+def _identity(commit, path):
+    with open(path, "rb") as f:
+        blob = f.read()
+    return (
+        str(commit.digest_pair.tar_digest),
+        str(commit.digest_pair.gzip_descriptor.digest),
+        commit.digest_pair.gzip_descriptor.size,
+        [(c.offset, c.length, c.hex_digest) for c in commit.chunks],
+        hashlib.sha256(blob).hexdigest(),
+    )
+
+
+@pytest.mark.skipif(not native.gear_scan_available(),
+                    reason="libgear.so not built")
+@pytest.mark.parametrize("backend_id", ["zlib-6", "pgzip-6-131072"])
+def test_commit_identical_across_worker_counts(tmp_path, backend_id):
+    """workers=1 vs workers=8 through the full sink (native pipeline
+    when available, incl. the pgzip route): identical layer tar bytes,
+    blob bytes, digests, and chunk fingerprints."""
+    if backend_id.startswith("pgzip") and not native.pgzip_available():
+        pytest.skip("pgzip not built")
+    root = _tree(tmp_path)
+    serial = str(tmp_path / "serial.tar.gz")
+    pooled = str(tmp_path / "pooled.tar.gz")
+    c1 = _commit(root, serial, backend_id, workers=1)
+    c8 = _commit(root, pooled, backend_id, workers=8)
+    assert c1.chunks, "TPU hasher must produce chunk fingerprints"
+    assert _identity(c1, serial) == _identity(c8, pooled)
+
+
+@pytest.mark.skipif(not native.gear_scan_available(),
+                    reason="libgear.so not built")
+def test_commit_identical_python_sink_buffer_readahead(tmp_path,
+                                                       monkeypatch):
+    """The pure-Python sink takes the BUFFER read-ahead mode
+    (prefetched bytes handed to tarfile directly); bytes must still be
+    identical to the serial commit."""
+    monkeypatch.setenv("MAKISU_TPU_NATIVE_SINK", "0")
+    root = _tree(tmp_path, seed=9)
+    serial = str(tmp_path / "serial.tar.gz")
+    pooled = str(tmp_path / "pooled.tar.gz")
+    c1 = _commit(root, serial, "zlib-6", workers=1)
+    c8 = _commit(root, pooled, "zlib-6", workers=8)
+    assert _identity(c1, serial) == _identity(c8, pooled)
+
+
+@pytest.mark.skipif(not native.gear_scan_available(),
+                    reason="libgear.so not built")
+def test_chunk_session_identity_across_workers():
+    """Direct ChunkSession sweep over a stream crossing the 4MiB
+    dispatch block: pooled scans + batched SHA yield the exact serial
+    boundaries and digests (awkward feed sizes included)."""
+    rng = np.random.default_rng(21)
+    payload = rng.integers(0, 256, size=BLOCK + 333_333,
+                           dtype=np.uint8).tobytes()
+    s1 = ChunkSession(workers=1)
+    s1.update(payload)
+    serial = s1.finish()
+    s8 = ChunkSession(workers=8)
+    for i in range(0, len(payload), 100_001):
+        s8.update(payload[i:i + 100_001])
+    pooled = s8.finish()
+    assert [(c.offset, c.length, c.hex) for c in serial] == \
+        [(c.offset, c.length, c.hex) for c in pooled]
+    for c in pooled[:3] + pooled[-3:]:
+        assert hashlib.sha256(
+            payload[c.offset:c.offset + c.length]).digest() == c.digest
+
+
+@pytest.mark.skipif(not native.sha_batch_available(),
+                    reason="libgear.so sha batch not built")
+def test_native_sha256_batch_matches_hashlib():
+    rng = np.random.default_rng(3)
+    datas = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+             for s in (0, 1, 55, 64, 65, 8191, 65_536)]
+    digests = native.sha256_batch(b"".join(datas),
+                                  [len(d) for d in datas])
+    for d, got in zip(datas, digests):
+        assert hashlib.sha256(d).digest() == got.tobytes()
+
+
+def test_read_ahead_buffer_and_fallback(tmp_path):
+    from makisu_tpu.snapshot.walk import tarinfo_from_stat
+    good = tmp_path / "good.bin"
+    good.write_bytes(b"g" * 10_000)
+    shrunk = tmp_path / "shrunk.bin"
+    shrunk.write_bytes(b"s" * 5_000)
+
+    def entry(p):
+        from makisu_tpu.snapshot.layer import ContentEntry
+        hdr = tarinfo_from_stat(str(p), p.name, str(tmp_path))
+        return ContentEntry(str(p), "/" + p.name, hdr)
+
+    e_good, e_shrunk = entry(good), entry(shrunk)
+    e_shrunk.hdr.size = 9_999  # header no longer matches the content
+    ra = _ReadAhead([("/good.bin", e_good), ("/shrunk.bin", e_shrunk)],
+                    buffer=True, workers=4)
+    assert ra.take("/good.bin") == b"g" * 10_000
+    # Mismatched size: advisory prefetch yields None — the writer falls
+    # back to streaming, which owns that failure mode.
+    assert ra.take("/shrunk.bin") is None
+    assert ra.take("/never-queued") is None
+    ra.close()
+
+
+def test_read_ahead_warm_mode_returns_none(tmp_path):
+    from makisu_tpu.snapshot.layer import ContentEntry
+    from makisu_tpu.snapshot.walk import tarinfo_from_stat
+    f = tmp_path / "f.bin"
+    f.write_bytes(b"x" * 4_096)
+    hdr = tarinfo_from_stat(str(f), "f.bin", str(tmp_path))
+    ra = _ReadAhead([("/f.bin", ContentEntry(str(f), "/f.bin", hdr))],
+                    buffer=False, workers=4)
+    assert ra.take("/f.bin") is None  # warm mode never hands bytes
+    ra.close()
+
+
+@pytest.mark.skipif(not native.sha_batch_available(),
+                    reason="libgear.so sha batch not built")
+def test_stage_metrics_recorded_for_pooled_commit():
+    """With workers > 1 the per-stage busy counters land in the build
+    registry — the series `makisu-tpu report` ranks to name the
+    bottleneck."""
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        rng = np.random.default_rng(5)
+        payload = rng.integers(0, 256, size=6_000_000,
+                               dtype=np.uint8).tobytes()
+        s = ChunkSession(workers=4)
+        s.update(payload)
+        assert s.finish()
+    finally:
+        metrics.reset_build_registry(token)
+    assert reg.counter_total(metrics.COMMIT_STAGE_BUSY,
+                             stage="gear_scan") > 0
+    assert reg.counter_total(metrics.COMMIT_STAGE_BUSY,
+                             stage="chunk_sha") > 0
+    assert reg.counter_total("makisu_bytes_hashed_total",
+                             backend="native") == len(payload)
+
+
+def test_report_names_commit_bottleneck():
+    from makisu_tpu.utils import traceexport
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        with metrics.span("build"):
+            metrics.stage_busy_add("tar_write", 1.5)
+            metrics.stage_busy_add("chunk_sha", 4.0)
+            metrics.stage_busy_add("compress", 0.5)
+    finally:
+        metrics.reset_build_registry(token)
+    text = traceexport.render_report(reg.report())
+    lines = text.splitlines()
+    idx = lines.index("commit pipeline stages (busy time):")
+    assert "chunk_sha" in lines[idx + 1]
+    assert "bottleneck" in lines[idx + 1]
+
+
+def test_hash_workers_config(monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_HASH_WORKERS", "3")
+    assert concurrency.hash_workers() == 3
+    token = concurrency.set_hash_workers(5)
+    assert concurrency.hash_workers() == 5
+    concurrency.reset_hash_workers(token)
+    assert concurrency.hash_workers() == 3
+    monkeypatch.setenv("MAKISU_TPU_HASH_WORKERS", "junk")
+    assert concurrency.hash_workers() == concurrency.default_hash_workers()
+
+
+def test_hash_linger_config(monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_HASH_LINGER_MS", "7.5")
+    assert concurrency.hash_linger_ms() == 7.5
+    concurrency.set_hash_linger_ms(1.25)
+    try:
+        assert concurrency.hash_linger_ms() == 1.25
+        from makisu_tpu.chunker.service import HashService
+        svc = HashService()
+        try:
+            assert svc.linger == pytest.approx(0.00125)
+        finally:
+            svc.close()
+    finally:
+        concurrency.set_hash_linger_ms(None)
+    assert concurrency.hash_linger_ms() == 7.5
+
+
+def test_gzip_backend_auto_resolves_concrete():
+    resolved = tario.resolve_backend("auto")
+    assert resolved == ("pgzip" if native.pgzip_available() else "zlib")
+    backend_id = tario.make_backend_id("auto", "default")
+    # Only concrete backends enter cache identity.
+    assert backend_id.startswith(resolved)
+    assert tario.backend_id_usable(backend_id)
+    assert tario.resolve_backend("zlib") == "zlib"
+
+
+def test_exists_prefetch_memo(tmp_path):
+    from makisu_tpu.cache.chunks import ChunkStore
+    store = ChunkStore(str(tmp_path / "cas"))
+    store.PROBE_BATCH = 2  # probes batch (default 256/task); force one
+    data = b"chunk-bytes" * 100
+    digest = hashlib.sha256(data).hexdigest()
+    store.put(digest, data)
+    missing = hashlib.sha256(b"absent").hexdigest()
+    store.note_fingerprint(digest)
+    store.note_fingerprint(missing)
+    concurrency.hash_pool().submit(lambda: None).result()  # drain
+    import time
+    for _ in range(100):
+        with store._memo_lock:
+            if store._exists_memo.get(digest):
+                break
+        time.sleep(0.01)
+    assert store._exists_cached(digest) is True
+    # A prefetch miss never short-circuits: the real stat decides.
+    assert store._exists_cached(missing) is False
+    store.reset_fingerprint_memo()
+    assert store._exists_cached(digest) is True  # falls back to stat
+
+
+def test_observer_streams_fingerprints_from_session():
+    from makisu_tpu.chunker import cdc
+    seen = []
+    token = cdc.set_chunk_observer(seen.append)
+    try:
+        rng = np.random.default_rng(11)
+        payload = rng.integers(0, 256, size=600_000,
+                               dtype=np.uint8).tobytes()
+        s = ChunkSession(workers=1)
+        s.update(payload)
+        chunks = s.finish()
+    finally:
+        cdc.reset_chunk_observer(token)
+    assert sorted(seen) == sorted(c.hex for c in chunks)
+
+
+def test_bench_device_failfast(monkeypatch):
+    """One stalled backend-init attempt must end the device budget —
+    the r05 run burned ~13 minutes retrying a wedged tunnel."""
+    import bench
+    calls = []
+    clock = [0.0]  # controlled time: the loop must not spin real budget
+
+    def fake_run_child(env, timeout, stall_timeout=None):
+        calls.append(timeout)
+        clock[0] += 120.0  # each attempt consumes budget
+        return ({"stage_reached": "import"},
+                "stalled: no stage line for 300s")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    result, err, attempts = bench._device_attempts(1800)
+    assert len(calls) == 1
+    assert attempts[-1]["skipped_remaining"] is True
+    # The kill switch restores the old spaced-retry behavior.
+    monkeypatch.setenv("MAKISU_BENCH_FAILFAST", "0")
+    calls.clear()
+    clock[0] = 0.0
+    bench._device_attempts(1800)
+    assert len(calls) > 1
+
+
+def test_pooled_route_respects_serial_floor(monkeypatch):
+    """workers=1 must be EXACTLY the serial pipeline: no pool, classic
+    inline hashing."""
+    s = ChunkSession(workers=1)
+    assert s._pool is None
+    # And the sub-4-core default keeps small hosts serial.
+    monkeypatch.setattr(os, "cpu_count", lambda: 2)
+    assert concurrency.default_hash_workers() == 1
+    monkeypatch.setattr(os, "cpu_count", lambda: 16)
+    assert concurrency.default_hash_workers() == 8
