@@ -77,6 +77,14 @@ def _emit(stage: str, **fields) -> None:
     rec = {"stage": stage}
     rec.update(fields)
     print(json.dumps(rec), flush=True)
+    try:
+        # Stamp the build-progress clock so the child's stall watchdog
+        # (_arm_forensics) measures idleness between STAGES, exactly
+        # like the parent's stage-aware watchdog does on stdout lines.
+        from makisu_tpu.utils import events as _events
+        _events.note_progress()
+    except Exception:  # noqa: BLE001 - telemetry must not fail stages
+        pass
     global _recorder
     if _recorder is None:
         sys.path.insert(0, _REPO)
@@ -88,6 +96,41 @@ def _emit(stage: str, **fields) -> None:
         print(json.dumps({"stage": "evidence",
                           "evidence_path": os.path.relpath(path, _REPO)}),
               flush=True)
+
+
+def _arm_forensics() -> None:
+    """Flight-recorder coverage for the device probe: the backend-init
+    stall has killed the device leg of EVERY round r01–r05 and left
+    nothing to diagnose. The wedge blocks the child's MAIN thread
+    inside backend init (a C call — no signal handler can run), so a
+    watchdog THREAD is the only capture mechanism: after
+    0.8 × MAKISU_BENCH_STALL_TIMEOUT of stage silence it dumps a
+    thread-stack bundle (`makisu-tpu doctor` readable) into
+    $MAKISU_TPU_DIAG_DIR — armed here to benchmarks/diag/ by default —
+    landing BEFORE the parent's kill at 1.0 ×. SIGTERM/SIGUSR1 dumps
+    ride along for the non-wedged failure modes."""
+    try:
+        os.environ.setdefault(
+            "MAKISU_TPU_DIAG_DIR", os.path.join(_REPO, "benchmarks",
+                                                "diag"))
+        from makisu_tpu.utils import flightrecorder, metrics
+        recorder = flightrecorder.FlightRecorder()
+        flightrecorder.install(recorder)
+        flightrecorder.install_signal_dumps(
+            recorder, metrics.global_registry(), "", tag="bench")
+        try:
+            window = 0.8 * float(os.environ.get(
+                "MAKISU_BENCH_STALL_TIMEOUT", "300") or 300)
+        except ValueError:
+            window = 240.0
+        if window > 0:
+            flightrecorder.StallWatchdog(
+                window, recorder,
+                flightrecorder.forced_bundle_path("", "stall",
+                                                  tag="bench"),
+                registry=metrics.global_registry()).start()
+    except Exception:  # noqa: BLE001 - forensics must never fail bench
+        pass
 
 
 def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
@@ -456,6 +499,7 @@ def _child_main() -> int:
     initializes. Every stage line is flushed BEFORE the next stage
     begins, so a hang/crash anywhere still leaves the parent with the
     deepest completed stage and any numbers measured so far."""
+    _arm_forensics()
     _emit("start",
           jax_platforms_env=os.environ.get("JAX_PLATFORMS", ""),
           pid=os.getpid())
@@ -781,6 +825,112 @@ def _transfer_micro() -> dict:
     }
 
 
+def _cache_explain_round() -> dict:
+    """Cache-attribution micro-round: build a small context cold, warm,
+    then once more with one edited file — through the real CLI with
+    ``--metrics-out``/``--explain-out`` — leaving the ledgers, the
+    metrics reports, and a rendered `explain` diff as artifacts next
+    to the BENCH record (benchmarks/explain/). The returned section
+    embeds the edited round's ledger summary (dedup ratio, bytes
+    refetched, flipped nodes), so every future perf round's cache
+    behavior is attributable instead of inferred."""
+    import shutil
+    import tempfile
+
+    # The explain round is a CPU-plane measurement; never let it touch
+    # a (possibly wedged) device tunnel. jax is not yet imported in
+    # the parent at this point, so the env override takes effect.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from makisu_tpu import cli
+    from makisu_tpu.utils import explain as explain_mod
+    from makisu_tpu.utils import ledger as ledger_mod
+
+    out_dir = os.path.join(_REPO, "benchmarks", "explain")
+    os.makedirs(out_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix="bench-explain-")
+    old_window = os.environ.get("MAKISU_TPU_STAT_CACHE_WINDOW_NS")
+    # Freshly-written files would otherwise hit the racily-clean
+    # re-hash guard and blur the warm build's statcache hits.
+    os.environ["MAKISU_TPU_STAT_CACHE_WINDOW_NS"] = "0"
+    try:
+        ctx = os.path.join(tmp, "ctx")
+        os.makedirs(ctx)
+        with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+            f.write("FROM scratch\nCOPY src/ /src/\n"
+                    "COPY data.bin /data.bin\n")
+        os.makedirs(os.path.join(ctx, "src"))
+        for i in range(8):
+            with open(os.path.join(ctx, "src", f"mod{i}.py"),
+                      "w") as f:
+                f.write(f"# module {i}\n" + "x = 1\n" * 200)
+        rng = np.random.default_rng(11)
+        with open(os.path.join(ctx, "data.bin"), "wb") as f:
+            f.write(rng.integers(0, 256, size=512 * 1024,
+                                 dtype=np.uint8).tobytes())
+        os.makedirs(os.path.join(tmp, "root"))
+
+        def build(led: str | None, rep: str | None) -> float:
+            argv = ["--log-level", "error"]
+            if led:
+                argv += ["--explain-out", led]
+            if rep:
+                argv += ["--metrics-out", rep]
+            t0 = time.perf_counter()
+            code = cli.main(argv + [
+                "build", ctx, "-t", "bench/explain:1",
+                "--hasher", "tpu",
+                "--storage", os.path.join(tmp, "storage"),
+                "--root", os.path.join(tmp, "root")])
+            if code != 0:
+                raise RuntimeError(f"explain-round build exited {code}")
+            return time.perf_counter() - t0
+
+        build(None, None)  # cold: populate layer cache + statcache
+        warm_led = os.path.join(out_dir, "warm_ledger.jsonl")
+        warm_s = build(warm_led, os.path.join(out_dir,
+                                              "warm_metrics.json"))
+        with open(os.path.join(ctx, "src", "mod3.py"), "a") as f:
+            f.write("EDITED = True\n")
+        edit_led = os.path.join(out_dir, "edited_ledger.jsonl")
+        edit_rep = os.path.join(out_dir, "edited_metrics.json")
+        edit_s = build(edit_led, edit_rep)
+
+        warm = ledger_mod.read_ledger(warm_led)
+        edited = ledger_mod.read_ledger(edit_led)
+        with open(edit_rep, encoding="utf-8") as f:
+            edit_report = json.load(f)
+        with open(os.path.join(out_dir, "edited_explain.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write(explain_mod.render_diff(edited, warm))
+            f.write("\n")
+            f.write(explain_mod.render_explain(edited, edit_report))
+        summary = edited["summary"]
+        return {
+            "warm_seconds": round(warm_s, 3),
+            "edited_seconds": round(edit_s, 3),
+            "warm_all_hit": all(
+                d["verdict"] == "hit"
+                for d in explain_mod.kv_chain(warm)),
+            "flipped_nodes": len(explain_mod.diff_ledgers(
+                edited, warm)["flipped_to_miss"]),
+            "changed_files": summary["statcache"]["changed_files"],
+            "bytes_rechunked": summary["bytes_added"],
+            "bytes_refetched": summary["bytes_refetched"],
+            "dedup_ratio": summary["dedup_ratio"],
+            "artifacts": sorted(
+                os.path.join("benchmarks", "explain", name)
+                for name in os.listdir(out_dir)),
+        }
+    finally:
+        if old_window is None:
+            os.environ.pop("MAKISU_TPU_STAT_CACHE_WINDOW_NS", None)
+        else:
+            os.environ["MAKISU_TPU_STAT_CACHE_WINDOW_NS"] = old_window
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     baseline = _cpu_baseline_gbps()
     errors: list[str] = []
@@ -930,6 +1080,15 @@ def main() -> int:
         record["transfer"] = _transfer_micro()
     except Exception as e:  # noqa: BLE001 - informational section
         record["transfer"] = {"error": str(e)[:200]}
+    # Cache-attribution micro-round: the ledger summary (dedup ratio,
+    # bytes refetched, flipped nodes on a 1-file edit) rides in the
+    # record, and the full ledgers/explain text land as artifacts in
+    # benchmarks/explain/ — future perf rounds can SEE what the cache
+    # did instead of inferring it from wall time.
+    try:
+        record["cache_explain"] = _cache_explain_round()
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["cache_explain"] = {"error": str(e)[:200]}
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))

@@ -25,6 +25,7 @@ from makisu_tpu.docker.image import (
 )
 from makisu_tpu.steps import FromStep, new_step
 from makisu_tpu.utils import events
+from makisu_tpu.utils import ledger
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -91,10 +92,16 @@ class BuildStage:
     def pull_cache_layers(self, cache_mgr) -> None:
         """Prefetch commit-node layers in order; stop at the first break
         in the chain (reference :299-313)."""
-        for node in self.nodes[1:]:
+        for i, node in enumerate(self.nodes[1:], start=1):
             if node.has_commit() or self.opts.force_commit:
-                if not node.pull_cache_layer(cache_mgr):
-                    return
+                # Attribute the consult (and everything it triggers —
+                # KV lookup, chunk-CAS scan, pack fetches) to this
+                # node, so the decision ledger can name the exact
+                # Dockerfile step that broke the cache chain.
+                with ledger.node_scope(stage=self.alias, step=i,
+                                       directive=node.step.directive):
+                    if not node.pull_cache_layer(cache_mgr):
+                        return
 
     def latest_fetched(self) -> int:
         latest = -1
@@ -133,7 +140,12 @@ class BuildStage:
                         skip=bool(opts.skip_build))
             with metrics.span("step", directive=node.step.directive,
                               index=i, cached=node.digest_pairs is not None,
-                              skip=opts.skip_build):
+                              skip=opts.skip_build), \
+                    ledger.node_scope(stage=self.alias, step=i,
+                                      directive=node.step.directive):
+                # The ledger node scope rides into every thread this
+                # step spawns (copy_context), so commit-side decisions
+                # (chunk indexing, async pushes) stay attributed.
                 config = node.build(cache_mgr, config, opts)
             events.emit("step", phase="done", stage=self.alias, index=i,
                         directive=node.step.directive,

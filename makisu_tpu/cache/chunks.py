@@ -27,6 +27,7 @@ from makisu_tpu.docker.image import Digest, DigestPair
 from makisu_tpu.registry import transfer
 from makisu_tpu.storage.cas import CASStore
 from makisu_tpu.utils import events
+from makisu_tpu.utils import ledger
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -450,32 +451,57 @@ class ChunkStore:
 
     def ensure_available(self,
                          chunks: list[tuple[int, int, str]],
-                         packs: list | None = None) -> bool:
+                         packs: list | None = None,
+                         ledger_key: str | None = None) -> bool:
         """True when every chunk is local after this call. The local
         scan is one stat per chunk; the misses (the NOVEL fraction
         after an incremental edit — this is the wire transfer chunk
         dedup reduces to) fetch on a thread pool, since per-blob round
-        trips, not bytes, dominate small-chunk transfer."""
+        trips, not bytes, dominate small-chunk transfer.
+
+        ``ledger_key`` (the layer hex) opts the call into the decision
+        ledger: one ``chunk_cas`` decision per consult carrying the
+        requested/missing chunk counts and the byte split — exactly the
+        per-key attribution cache-affinity routing needs as its
+        signal."""
         # A digest repeated at several offsets (dedup within one layer)
         # must fetch once, not once per occurrence racing on the pool.
+        lengths: dict[str, int] = {}
+        for _, length, hex_digest in chunks:
+            lengths.setdefault(hex_digest, length)
         missing = sorted({h for _, _, h in chunks
                           if not self.cas.exists(h)})
+        n_missing = len(missing)
+        bytes_missing = sum(lengths[h] for h in missing)
+
+        def outcome(available: bool) -> bool:
+            if ledger_key is not None:
+                verdict = ("hit" if not n_missing
+                           else "partial" if available else "miss")
+                ledger.record(
+                    "chunk_cas", ledger_key, verdict,
+                    reason=None if available else "chunks_incomplete",
+                    requested=len(lengths), missing=n_missing,
+                    bytes_total=sum(n for _, n, _ in chunks),
+                    bytes_refetched=bytes_missing if available else 0)
+            return available
+
         if not missing:
-            return True
+            return outcome(True)
         if self.registry is None:
-            return False
+            return outcome(False)
         if packs:
             missing, mapped_failed = self._fetch_from_packs(
                 chunks, packs, missing)
             if not missing and not mapped_failed:
-                return True
+                return outcome(True)
             if mapped_failed:
                 # Pack-mapped chunks were never pushed as individual
                 # blobs: a per-chunk fallback for them is a guaranteed
                 # 404 per chunk (~100k futile round trips on a big
                 # layer). Their pack is gone/corrupt — report
                 # unavailable so the pull degrades to the blob route.
-                return False
+                return outcome(False)
         # The shared transfer engine bounds these alongside every other
         # wire path (they used to ride their own ThreadPoolExecutor(8),
         # unbounded against concurrent builds' transfers).
@@ -484,7 +510,7 @@ class ChunkStore:
                             route="blob")
         events.emit("chunk_fetch", route="blob", fetched=sum(ok),
                     requested=len(missing))
-        return all(ok)
+        return outcome(all(ok))
 
     # Coalesce needed spans within a pack when the gap between them is
     # under this: one ranged GET fetching a few spare KiB beats two
@@ -862,6 +888,42 @@ class ChunkStore:
             os.unlink(path)
 
 
+def _record_index(layer_hex: str, cache_id: str,
+                  triples: list[tuple[int, int, str]],
+                  added: list[str]) -> None:
+    """Per-layer dedup accounting after index_layer: how many of the
+    layer's bytes were NOVEL (the re-chunked fraction an edit cost)
+    vs already held — the `makisu-tpu explain` blame for commit-side
+    work, plus aggregate counters and a per-layer dedup-ratio gauge so
+    chunking efficiency is visible without a ledger."""
+    bytes_total = sum(n for _, n, _ in triples)
+    lengths: dict[str, int] = {}
+    for _, n, h in triples:
+        lengths.setdefault(h, n)
+    bytes_added = sum(lengths[h] for h in set(added))
+    bytes_reused = bytes_total - bytes_added
+    metrics.counter_add("makisu_chunk_bytes_total", bytes_added,
+                        result="added")
+    metrics.counter_add("makisu_chunk_bytes_total", bytes_reused,
+                        result="reused")
+    ratio = bytes_reused / bytes_total if bytes_total else 0.0
+    # Per-layer series only in the BUILD registry (bounded by the
+    # build's layer count); the process-global registry gets one
+    # unlabeled last-layer series — a long-lived worker must not grow
+    # a permanent /metrics series per layer it ever committed (the
+    # per-layer detail lives in each build's ledger + report).
+    bound = metrics.active_registry()
+    if bound is not metrics.global_registry():
+        bound.gauge_set("makisu_chunk_dedup_ratio", ratio,
+                        layer=layer_hex[:12])
+    metrics.global_registry().gauge_set("makisu_chunk_dedup_ratio",
+                                        ratio)
+    ledger.record("chunk_index", layer_hex, "indexed",
+                  cache_id=cache_id, chunks=len(triples),
+                  added=len(added), bytes_total=bytes_total,
+                  bytes_added=bytes_added, bytes_reused=bytes_reused)
+
+
 def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
     """Wire a ChunkStore into a CacheManager: index chunks on push,
     reconstitute layers on pull when the blob is missing locally. If the
@@ -877,13 +939,14 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         inner_push(cache_id, pair, commit)
         if pair is not None and commit is not None and commit.chunks:
             try:
-                path = manager.store.layers.path(
-                    pair.gzip_descriptor.digest.hex())
+                layer_hex = pair.gzip_descriptor.digest.hex()
+                path = manager.store.layers.path(layer_hex)
                 triples = [(c.offset, c.length, c.hex_digest)
                            for c in commit.chunks]
                 added = chunk_store.index_layer(path, triples)
                 metrics.counter_add("makisu_chunks_indexed_total",
                                     len(added))
+                _record_index(layer_hex, cache_id, triples, added)
                 log.info("indexed %d new chunks for %s", len(added),
                          cache_id)
             except FileNotFoundError:
@@ -982,17 +1045,13 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
         backend we lack falls through to the blob route, whose HEAD
         check degrades an unmaterializable hit to a miss at pull time —
         never to a failed build after execution was already skipped."""
-        from makisu_tpu.cache.manager import CacheMiss, \
-            decode_entry_full
-        raw = manager._get_raw(cache_id)
-        if raw is None:
-            metrics.counter_add("makisu_cache_pull_total", result="miss")
-            events.emit("cache", result="miss", cache_id=cache_id)
-            raise CacheMiss(cache_id)
-        pair, chunks, gz_backend, packs = decode_entry_full(raw)
+        from makisu_tpu.cache.manager import get_entry
+        raw, pair, chunks, gz_backend, packs = get_entry(
+            manager, cache_id)
         if pair is None:
             metrics.counter_add("makisu_cache_pull_total", result="empty")
             events.emit("cache", result="empty", cache_id=cache_id)
+            ledger.record("kv", cache_id, "empty")
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not manager.store.layers.exists(hex_digest) and chunks:
@@ -1000,8 +1059,11 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 log.info("cache hit %s: gzip backend %r not replayable "
                          "here; trying the blob route", cache_id,
                          gz_backend)
+                ledger.record("chunk_cas", hex_digest, "stale",
+                              reason="gz_backend")
             elif chunk_store.ensure_available(
-                    [tuple(c) for c in chunks], packs):
+                    [tuple(c) for c in chunks], packs,
+                    ledger_key=hex_digest):
                 with manager._lock:
                     manager._lazy[hex_digest] = raw
                 metrics.counter_add("makisu_cache_pull_total",
@@ -1010,6 +1072,9 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 events.emit("cache", result="hit", cache_id=cache_id,
                             layer=hex_digest, route="chunks",
                             chunks=len(chunks))
+                ledger.record("kv", cache_id, "hit", layer=hex_digest,
+                              route="chunks",
+                              bytes_saved=pair.gzip_descriptor.size)
                 log.info("cache hit %s -> %s (lazy: %d chunks "
                          "available)", cache_id, hex_digest, len(chunks))
                 if not manager.lazy_enabled():
@@ -1079,7 +1144,8 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
             _, chunks, _, packs = _lazy_entry(hex_digest)
             if chunks:
                 triples = [tuple(c) for c in chunks]
-                if chunk_store.ensure_available(triples, packs):
+                if chunk_store.ensure_available(triples, packs,
+                                                ledger_key=hex_digest):
 
                     @contextlib.contextmanager
                     def _chunk_tar():

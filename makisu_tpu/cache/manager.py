@@ -23,6 +23,7 @@ from makisu_tpu.docker.image import (
     DigestPair,
 )
 from makisu_tpu.utils import events
+from makisu_tpu.utils import ledger
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -84,6 +85,48 @@ def decode_entry(raw: str) -> tuple[DigestPair | None, list]:
     return pair, chunks
 
 
+def record_miss(cache_id: str, reason: str,
+                verdict: str = "miss", **fields) -> None:
+    """One cache-consult failure, recorded everywhere it must land:
+    the legacy result="miss" counter (dashboards already join on it),
+    the reason-labeled miss counter
+    (``makisu_cache_miss_total{reason=absent|stale|decode_error|
+    kv_error}``), the ``cache`` event stream, and the decision ledger.
+    ``verdict`` distinguishes a genuinely absent entry ("miss") from
+    one that EXISTED but could not be honored ("stale") and from
+    infrastructure failures ("error")."""
+    metrics.counter_add("makisu_cache_pull_total", result="miss")
+    metrics.counter_add("makisu_cache_miss_total",
+                        reason=ledger.coarse_reason(reason))
+    events.emit("cache", result="miss", cache_id=cache_id,
+                reason=reason)
+    ledger.record("kv", cache_id, verdict, reason=reason, **fields)
+
+
+def get_entry(manager, cache_id: str) -> tuple[str, "DigestPair | None",
+                                               list, str | None, list]:
+    """Shared consult head for both pull routes (blob and chunk-aware):
+    KV lookup + decode with every failure mode classified and recorded
+    — absent, KV errored out, entry undecodable. Returns
+    ``(raw, pair, chunks, gz_backend, packs)``; raises CacheMiss on
+    any recorded failure."""
+    raw, reason = manager._get_raw2(cache_id)
+    if raw is None:
+        record_miss(cache_id, reason or "absent",
+                    verdict="error" if reason == "kv_error" else "miss")
+        raise CacheMiss(cache_id)
+    try:
+        pair, chunks, gz_backend, packs = decode_entry_full(raw)
+    except (ValueError, KeyError, TypeError) as e:
+        # A mangled entry (foreign writer, torn KV value) must degrade
+        # to a rebuild, not crash the prefetch chain — and must be
+        # distinguishable from a plain absent key.
+        log.warning("cache entry %s undecodable (%s); treating as miss",
+                    cache_id, e)
+        record_miss(cache_id, "decode_error", verdict="error")
+        raise CacheMiss(cache_id) from e
+    return raw, pair, chunks, gz_backend, packs
+
 
 
 class CacheManager:
@@ -118,6 +161,15 @@ class CacheManager:
 
     def _get_raw(self, cache_id: str) -> str | None:
         """Entry lookup: build-local memory first, then the KV chain."""
+        return self._get_raw2(cache_id)[0]
+
+    def _get_raw2(self, cache_id: str) -> tuple[str | None, str | None]:
+        """Entry lookup distinguishing WHY nothing came back: ``(raw,
+        None)`` on success, ``(None, "absent")`` when the store answered
+        with no entry, ``(None, "kv_error")`` when every KV attempt
+        raised — the ledger and the miss-reason counter need the
+        difference (an alert on kv_error is an infrastructure page; one
+        on absent is just a cold cache)."""
         raw = self._mem.get(cache_id)
         if raw is None:
             for attempt in range(_KV_RETRIES):
@@ -130,8 +182,8 @@ class CacheManager:
                     log.warning("cache KV get %s failed (try %d): %s",
                                 cache_id, attempt + 1, e)
             else:
-                return None
-        return raw
+                return None, "kv_error"
+        return (raw, None) if raw is not None else (None, "absent")
 
     def pull_cache(self, cache_id: str) -> DigestPair | None:
         """Layer for this cache ID. Returns None for the EMPTY sentinel (a
@@ -139,26 +191,20 @@ class CacheManager:
         entry exists. The blob is NOT transferred eagerly when a
         materialization route exists (see _lazy); callers that need the
         bytes go through open_layer_tar()/materialize()."""
-        raw = self._get_raw(cache_id)
-        if raw is None:
-            metrics.counter_add("makisu_cache_pull_total", result="miss")
-            events.emit("cache", result="miss", cache_id=cache_id)
-            raise CacheMiss(cache_id)
-        pair, _chunks = decode_entry(raw)
+        raw, pair, _chunks, _gz, _packs = get_entry(self, cache_id)
         if pair is None:
             # Sentinel: the step is known to produce no layer.
             metrics.counter_add("makisu_cache_pull_total", result="empty")
             events.emit("cache", result="empty", cache_id=cache_id)
+            ledger.record("kv", cache_id, "empty")
             return None
         hex_digest = pair.gzip_descriptor.digest.hex()
         if not self.store.layers.exists(hex_digest):
             if self.registry is None:
                 log.info("cache hit %s but layer %s not local; ignoring",
                          cache_id, hex_digest)
-                metrics.counter_add("makisu_cache_pull_total",
-                                    result="miss")
-                events.emit("cache", result="miss", cache_id=cache_id,
-                            reason="layer_not_local")
+                record_miss(cache_id, "layer_not_local", verdict="stale",
+                            layer=hex_digest)
                 raise CacheMiss(cache_id)
             if self.lazy_enabled():
                 # Materializability must be settled HERE: a hit is a
@@ -177,10 +223,8 @@ class CacheManager:
                 if not remote_ok:
                     log.info("cache hit %s but blob %s gone from the "
                              "registry; ignoring", cache_id, hex_digest)
-                    metrics.counter_add("makisu_cache_pull_total",
-                                        result="miss")
-                    events.emit("cache", result="miss", cache_id=cache_id,
-                                reason="blob_gone")
+                    record_miss(cache_id, "blob_gone", verdict="stale",
+                                layer=hex_digest)
                     raise CacheMiss(cache_id)
                 with self._lock:
                     self._lazy[hex_digest] = raw
@@ -190,12 +234,18 @@ class CacheManager:
                                     result="hit")
                 events.emit("cache", result="hit", cache_id=cache_id,
                             layer=hex_digest, lazy=True)
+                ledger.record("kv", cache_id, "hit", layer=hex_digest,
+                              route="lazy_blob",
+                              bytes_saved=pair.gzip_descriptor.size)
                 return pair
             self.registry.pull_layer(pair.gzip_descriptor.digest)
         log.info("cache hit %s -> %s", cache_id, hex_digest)
         metrics.counter_add("makisu_cache_pull_total", result="hit")
         events.emit("cache", result="hit", cache_id=cache_id,
                     layer=hex_digest)
+        ledger.record("kv", cache_id, "hit", layer=hex_digest,
+                      route="blob",
+                      bytes_saved=pair.gzip_descriptor.size)
         return pair
 
     # -- materialization (the lazy half of pull) --------------------------

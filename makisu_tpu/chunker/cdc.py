@@ -55,6 +55,11 @@ BLOCK = 4 * 1024 * 1024  # bytes shipped to the device per gear dispatch
 # batches must be big enough that hashing time dwarfs handoff time.
 SHA_BATCH_BYTES = 1024 * 1024
 
+# makisu_chunk_size_bytes histogram ladder: powers of two around the
+# 8KiB average / 64KiB max chunk policy (gear.DEFAULT_*).
+CHUNK_SIZE_BUCKETS = (1024.0, 2048.0, 4096.0, 8192.0, 16384.0,
+                      32768.0, 65536.0, 131072.0)
+
 # Fingerprint observer: the chunk-dedup cache registers a callback per
 # build (cache/chunks.attach_chunk_dedup) and CAS-existence lookups
 # issue as fingerprints stream out of the hash stage, instead of as a
@@ -379,6 +384,14 @@ class ChunkSession:
             return []
         self._service_pending = []
         self._chunks.sort(key=lambda c: c.offset)
+        if self._chunks:
+            # One batched fold per stream (never per chunk): chunking
+            # efficiency — are cuts landing near the 8KiB target, or
+            # degenerating to min/max forced cuts? — visible in
+            # /metrics without a ledger.
+            metrics.observe_batch("makisu_chunk_size_bytes",
+                                  [c.length for c in self._chunks],
+                                  buckets=CHUNK_SIZE_BUCKETS)
         return self._chunks
 
     # -- internals --------------------------------------------------------

@@ -72,6 +72,12 @@ def make_parser() -> argparse.ArgumentParser:
     parser.add_argument("--events-out", default="", metavar="FILE",
                         help="write this command's build events (JSONL, "
                              "one event per line) to FILE as they happen")
+    parser.add_argument("--explain-out", default="", metavar="FILE",
+                        help="write this command's cache-decision ledger "
+                             "(JSONL, schema makisu-tpu.ledger.v1: one "
+                             "line per cache consult with verdict/reason/"
+                             "blame, plus a summary line) to FILE — the "
+                             "input `makisu-tpu explain` renders")
     parser.add_argument("--diag-out", default="", metavar="FILE",
                         help="write a JSON diagnostic bundle (flight-"
                              "recorder ring, open spans, thread stacks, "
@@ -196,6 +202,25 @@ def make_parser() -> argparse.ArgumentParser:
                         help="an --events-out JSONL log to include "
                              "(torn final lines of killed builds are "
                              "salvaged)")
+
+    explain = sub.add_parser(
+        "explain", help="chunk-level cache miss attribution from a "
+                        "build's decision ledger")
+    explain.add_argument("ledger",
+                         help="an --explain-out JSONL ledger (an "
+                              "--events-out log containing "
+                              "cache_decision events also works)")
+    explain.add_argument("--baseline", default="", metavar="LEDGER",
+                         help="a previous build's ledger: render the "
+                              "build-to-build diff (keys that flipped "
+                              "hit→miss, file-level blame, re-chunked "
+                              "byte delta) instead of single-build "
+                              "attribution")
+    explain.add_argument("--metrics", default="", metavar="FILE",
+                         help="the matching --metrics-out report: adds "
+                              "the warm-rebuild floor profile "
+                              "(irreducible vs cache-avoidable wall "
+                              "time per phase)")
 
     doctor = sub.add_parser(
         "doctor", help="diagnose a failure-forensics bundle")
@@ -562,6 +587,49 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_explain(args) -> int:
+    """Render a cache-decision ledger: which node broke the cache
+    chain and which files broke it (default), what flipped between two
+    builds (``--baseline``), and where the warm-rebuild floor actually
+    goes (``--metrics``). Torn ledgers (build killed mid-write) are
+    salvaged line-by-line, same as ``report --events``."""
+    import json as json_mod
+
+    from makisu_tpu.utils import explain as explain_mod
+    from makisu_tpu.utils import ledger as ledger_mod
+
+    def load(path: str) -> dict:
+        try:
+            led = ledger_mod.read_ledger(path)
+        except ValueError as e:
+            log.warning("%s; analyzing the valid lines only", e)
+            led = ledger_mod.read_ledger(path, skip_invalid=True)
+        if not led["decisions"] and not led["header"]:
+            # Both inputs get this check: a wrong --baseline file
+            # would otherwise render a misleading "0 flips" diff.
+            raise SystemExit(
+                f"{path}: no ledger header or cache_decision lines "
+                f"(expected an --explain-out file, schema "
+                f"{ledger_mod.LEDGER_SCHEMA!r})")
+        return led
+
+    current = load(args.ledger)
+    if args.baseline:
+        print(explain_mod.render_diff(current, load(args.baseline)),
+              end="")
+        return 0
+    report = None
+    if args.metrics:
+        with open(args.metrics, encoding="utf-8") as f:
+            report = json_mod.load(f)
+        if report.get("schema") != "makisu-tpu.metrics.v1":
+            raise SystemExit(
+                f"{args.metrics}: not a makisu-tpu metrics report "
+                f"(schema {report.get('schema')!r})")
+    print(explain_mod.render_explain(current, report), end="")
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Render a diagnostic bundle into a human diagnosis: the stuck
     span, wedged threads, transfer-engine backlog, and the resource
@@ -633,7 +701,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
                 "diff": cmd_diff, "worker": cmd_worker,
-                "report": cmd_report, "doctor": cmd_doctor}
+                "report": cmd_report, "doctor": cmd_doctor,
+                "explain": cmd_explain}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -698,6 +767,21 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:
             log.error("failed to open events log %s: %s",
                       args.events_out, e)
+    # The cache-decision ledger rides the same event bus: the writer is
+    # just a sink filtering cache_decision events into the compact
+    # --explain-out artifact (header + one line per consult + summary).
+    ledger_writer = None
+    ledger_token = None
+    if args.explain_out:
+        from makisu_tpu.utils import ledger as ledger_mod
+        try:
+            ledger_writer = ledger_mod.LedgerWriter(
+                args.explain_out, trace_id=registry.trace_id,
+                command=args.command or "")
+            ledger_token = events.add_sink(ledger_writer)
+        except OSError as e:
+            log.error("failed to open cache ledger %s: %s",
+                      args.explain_out, e)
     # The watchdog starts AFTER every event sink is bound: it runs
     # under a copy of this context, so its `stall` event reaches the
     # recorder, the --events-out log, and (in a worker) the client's
@@ -775,6 +859,13 @@ def main(argv: list[str] | None = None) -> int:
         if events_writer is not None:
             events_writer.close()
             log.info("event log written to %s", args.events_out)
+        if ledger_token is not None:
+            events.reset_sink(ledger_token)
+        if ledger_writer is not None:
+            # Closing AFTER the build_end emit above: the summary line
+            # carries the exit code the writer captured from it.
+            ledger_writer.close()
+            log.info("cache ledger written to %s", args.explain_out)
         flightrecorder.uninstall(recorder_tokens)
         events.reset_progress_cell(progress_token)
         metrics.reset_build_registry(metrics_token)

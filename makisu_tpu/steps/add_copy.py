@@ -15,7 +15,11 @@ from glob import glob
 from makisu_tpu.context import BuildContext
 from makisu_tpu.snapshot import CopyOperation, eval_symlinks
 from makisu_tpu.steps.base import BuildStep
-from makisu_tpu.utils import pathutils, sysutils
+from makisu_tpu.utils import ledger, metrics, pathutils, sysutils
+
+# Changed-file paths carried per statcache ledger decision (the blame
+# list `makisu-tpu explain` prints); beyond it only the count grows.
+_BLAME_KEEP = 20
 
 
 class AddCopyStep(BuildStep):
@@ -94,10 +98,17 @@ class AddCopyStep(BuildStep):
         so a context change invalidates exactly the right steps."""
         checksum = zlib.crc32(
             (seed + self.directive + self.args).encode())
+        # Stat-cache tally for this step's context walk: which files'
+        # content IDs came from the stat cache and which had to
+        # re-hash (with the changed paths — the file-level blame the
+        # decision ledger attaches to this step's cache ID).
+        tally = {"files": 0, "hits": 0, "misses": 0,
+                 "bytes_rehashed": 0, "changed": []}
         if not self.from_stage:
             # Cross-stage copies rely on chained stage cache IDs instead.
             for source in self._resolve_sources(ctx):
-                checksum = self._checksum_tree(ctx, source, checksum)
+                checksum = self._checksum_tree(ctx, source, checksum,
+                                               tally)
         for name, content in self.inline_files:
             # Inline heredoc files are content too (their bodies carry
             # substituted build args, so identity must track them).
@@ -109,9 +120,30 @@ class AddCopyStep(BuildStep):
             checksum = zlib.crc32(name.encode(), checksum)
             checksum = zlib.crc32(content.encode(), checksum)
         self.cache_id = format(checksum & 0xFFFFFFFF, "x")
+        self._record_stat_tally(tally)
+
+    def _record_stat_tally(self, tally: dict) -> None:
+        """Flush the context-walk tally once per step (never per file —
+        a 100k-file walk must not pay 100k counter locks) and record
+        the step's statcache decision against its cache ID."""
+        if not tally["files"]:
+            return
+        if tally["hits"]:
+            metrics.counter_add("makisu_statcache_total", tally["hits"],
+                                result="hit")
+        if tally["misses"]:
+            metrics.counter_add("makisu_statcache_total",
+                                tally["misses"], result="miss")
+        ledger.record(
+            "statcache", self.cache_id,
+            "hit" if not tally["misses"] else "miss",
+            directive=self.directive, files=tally["files"],
+            hits=tally["hits"], misses=tally["misses"],
+            bytes_rehashed=tally["bytes_rehashed"],
+            changed_files=list(tally["changed"]))
 
     def _checksum_tree(self, ctx: BuildContext, path: str,
-                       checksum: int) -> int:
+                       checksum: int, tally: dict | None = None) -> int:
         if not os.path.lexists(path):
             return checksum
         if ctx.context_path_ignored(path):
@@ -128,14 +160,27 @@ class AddCopyStep(BuildStep):
         if os.path.isdir(path):
             for name in sorted(os.listdir(path)):
                 checksum = self._checksum_tree(
-                    ctx, os.path.join(path, name), checksum)
+                    ctx, os.path.join(path, name), checksum, tally)
             return checksum
         # Per-file content summary, framed into the rolling checksum.
         # The summary (not the raw byte stream) is what chains, so a
         # file's crc can come from the stat-keyed cache
         # (utils/statcache.py) and a warm rebuild re-reads only files
         # whose stat changed — identical cache IDs either way.
-        file_crc = ctx.content_ids.get(rel, st)
+        file_crc, why = ctx.content_ids.lookup(rel, st)
+        if tally is not None:
+            tally["files"] += 1
+            if why == "hit":
+                tally["hits"] += 1
+            else:
+                tally["misses"] += 1
+                tally["bytes_rehashed"] += st.st_size
+                # Blame only REAL changes: a racy/disabled re-hash is a
+                # perf cost, not a content change, and must not name
+                # an innocent file in the explain output.
+                if (why in ("absent", "stat_changed")
+                        and len(tally["changed"]) < _BLAME_KEEP):
+                    tally["changed"].append(rel)
         if file_crc is None:
             file_crc = 0
             with open(path, "rb") as f:

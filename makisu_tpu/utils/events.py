@@ -22,9 +22,11 @@ Event shape: ``{"ts": <unix seconds>, "type": <str>, ...fields}``.
 Types emitted today: ``build_start``/``build_end`` (cli.py),
 ``span_start``/``span_end`` (metrics.span), ``step`` (builder/stage.py,
 ``phase=start|done``), ``cache`` (cache/manager.py + cache/chunks.py,
-``result=hit|miss|empty``), ``chunk_fetch`` (cache/chunks.py), and
-``registry_blob`` (registry/client.py). The set is open: any module may
-emit new types; consumers must ignore types they don't know.
+``result=hit|miss|empty``), ``cache_decision`` (utils/ledger.py — the
+cache-decision ledger's structured consult record), ``chunk_fetch``
+(cache/chunks.py), and ``registry_blob`` (registry/client.py). The set
+is open: any module may emit new types; consumers must ignore types
+they don't know.
 
 Like the rest of the telemetry layer: stdlib-only, import-cycle-free,
 and never able to fail a build — a raising sink is swallowed (and

@@ -104,21 +104,34 @@ class ContentIDCache:
                 st.st_dev]
 
     def get(self, rel: str, st: os.stat_result) -> int | None:
+        return self.lookup(rel, st)[0]
+
+    def lookup(self, rel: str,
+               st: os.stat_result) -> tuple[int | None, str]:
+        """``get`` plus WHY: ``(crc, "hit")`` on a trusted entry, else
+        ``(None, reason)`` with reason one of ``disabled``, ``absent``
+        (first sight of this path), ``stat_changed`` (an entry exists
+        but the file's stat quadruple moved — a real content/metadata
+        change, the blame signal ``makisu-tpu explain`` reports), or
+        ``racy`` (entry too fresh to trust; a bounded re-hash, not a
+        change)."""
         if not enabled():
-            return None
+            return None, "disabled"
         with self._lock:
             entry = self._load_locked().get(self._ns + rel)
-            if entry is None or entry[0] != self._key(st):
-                return None
+            if entry is None:
+                return None, "absent"
+            if entry[0] != self._key(st):
+                return None, "stat_changed"
             # Racily-clean guard: if the file was modified in the same
             # coarse-timestamp tick it was hashed in, the stat key
             # cannot distinguish a later same-size edit — re-hash.
             hashed_at = int(entry[2])
             newest = max(st.st_mtime_ns, st.st_ctime_ns)
             if hashed_at - newest < racy_window_ns():
-                return None
+                return None, "racy"
             self._touched.add(self._ns + rel)
-            return int(entry[1])
+            return int(entry[1]), "hit"
 
     def put(self, rel: str, st: os.stat_result, crc: int) -> None:
         with self._lock:

@@ -344,6 +344,30 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             succeeded = self._builds_succeeded
             failed = self._builds_failed
         g = metrics.global_registry()
+        # Process-wide cache economics: hit/miss totals, misses broken
+        # down by reason, and the chunk plane's dedup split — the
+        # per-worker signal a fleet scheduler's cache-affinity routing
+        # reads without a Prometheus scrape (full per-key attribution
+        # comes from each build's --explain-out ledger).
+        chunk_added = g.counter_total("makisu_chunk_bytes_total",
+                                      result="added")
+        chunk_reused = g.counter_total("makisu_chunk_bytes_total",
+                                       result="reused")
+        cache = {
+            "hits": int(g.counter_total("makisu_cache_pull_total",
+                                        result="hit")),
+            "misses": int(g.counter_total("makisu_cache_pull_total",
+                                          result="miss")),
+            "miss_reasons": {
+                reason: int(n) for reason, n in sorted(
+                    g.counter_by_label("makisu_cache_miss_total",
+                                       "reason").items())},
+            "chunk_bytes_added": int(chunk_added),
+            "chunk_bytes_reused": int(chunk_reused),
+            "chunk_dedup_ratio": round(
+                chunk_reused / (chunk_added + chunk_reused), 4)
+                if (chunk_added + chunk_reused) else 0.0,
+        }
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -352,6 +376,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "builds_succeeded": succeeded,
             "builds_failed": failed,
             "active_builds": started - succeeded - failed,
+            "cache": cache,
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on
             # active_builds > 0 && last_progress_seconds > window sees

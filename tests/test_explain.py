@@ -1,0 +1,472 @@
+"""Cache-decision ledger + `makisu-tpu explain` tests.
+
+Covers the ledger artifact (schema, summary, torn-file salvage), the
+golden `explain`/`explain --baseline` renderings on a synthetic
+scenario, the scripted end-to-end acceptance (two builds of one
+context, one edited file → explain names the file, the flipped keys,
+and the re-chunked byte count), the worker round-trip (decisions ride
+the /build event stream identical to the ledger file), and the
+miss-reason / statcache / chunk-size instrumentation underneath."""
+
+import json
+import os
+
+import pytest
+
+from makisu_tpu import cli
+from makisu_tpu.utils import events, explain, ledger, metrics
+
+
+def _mk_ledger(decisions, trace_id="feedfacefeedface"):
+    acc = ledger.LedgerSummary()
+    for decision in decisions:
+        acc.add(decision)
+    summary = acc.to_dict()
+    summary["exit_code"] = 0
+    return {"header": {"schema": ledger.LEDGER_SCHEMA,
+                       "trace_id": trace_id, "command": "build"},
+            "decisions": decisions, "summary": summary}
+
+
+def _baseline_ledger():
+    return _mk_ledger([
+        {"type": "cache_decision", "source": "statcache",
+         "key": "aaaa1111", "verdict": "hit", "directive": "COPY",
+         "files": 3, "hits": 3, "misses": 0, "bytes_rehashed": 0,
+         "changed_files": []},
+        {"type": "cache_decision", "source": "kv", "key": "aaaa1111",
+         "verdict": "hit", "stage": "0", "step": 1, "directive": "COPY",
+         "route": "blob", "bytes_saved": 1000},
+        {"type": "cache_decision", "source": "kv", "key": "bbbb2222",
+         "verdict": "hit", "stage": "0", "step": 2, "directive": "COPY",
+         "route": "chunks", "bytes_saved": 4096},
+    ])
+
+
+def _edited_ledger():
+    return _mk_ledger([
+        {"type": "cache_decision", "source": "statcache",
+         "key": "aaaa1111", "verdict": "hit", "directive": "COPY",
+         "files": 3, "hits": 3, "misses": 0, "bytes_rehashed": 0,
+         "changed_files": []},
+        {"type": "cache_decision", "source": "statcache",
+         "key": "cccc3333", "verdict": "miss", "directive": "COPY",
+         "files": 3, "hits": 2, "misses": 1, "bytes_rehashed": 512,
+         "changed_files": ["src/app.py"]},
+        {"type": "cache_decision", "source": "kv", "key": "aaaa1111",
+         "verdict": "hit", "stage": "0", "step": 1, "directive": "COPY",
+         "route": "blob", "bytes_saved": 1000},
+        {"type": "cache_decision", "source": "kv", "key": "cccc3333",
+         "verdict": "miss", "reason": "absent", "stage": "0", "step": 2,
+         "directive": "COPY"},
+        {"type": "cache_decision", "source": "chunk_cas",
+         "key": "deadbeef00", "verdict": "partial", "stage": "0",
+         "step": 2, "directive": "COPY", "requested": 10, "missing": 2,
+         "bytes_total": 81920, "bytes_refetched": 16384},
+        {"type": "cache_decision", "source": "chunk_index",
+         "key": "deadbeef00", "verdict": "indexed", "stage": "0",
+         "step": 2, "directive": "COPY", "cache_id": "cccc3333",
+         "chunks": 10, "added": 2, "bytes_total": 81920,
+         "bytes_added": 16384, "bytes_reused": 65536},
+    ])
+
+
+GOLDEN_EXPLAIN = """\
+makisu-tpu cache explain — command: build
+trace id: feedfacefeedface
+decisions: 6  (hit=2  indexed=1  miss=2  partial=1)
+
+cache chain (KV consults, build order):
+  stage 0 step 1 COPY      aaaa1111           hit  saved 1000B
+  stage 0 step 2 COPY      cccc3333           miss (absent)  ← broke the cache chain
+
+blame (stage 0 step 2 COPY key cccc3333): 1/3 context files re-hashed
+    changed: src/app.py
+
+chunk plane (per layer):
+  indexed deadbeef00  2/10 chunks new — re-chunked 16.0KiB of 80.0KiB (dedup 80.0%)  [stage 0 step 2 COPY]
+  consult deadbeef00  2/10 chunks missing — partial, refetched 16.0KiB of 80.0KiB
+
+bytes: saved 1000B from cache · refetched 16.0KiB over the wire · re-chunked 16.0KiB (dedup ratio 80.0%)
+stat-cache: 5 hit / 1 re-hashed (changed: src/app.py)
+"""
+
+GOLDEN_DIFF = """\
+makisu-tpu cache diff — baseline feedfacefeedface → current feedfacefeedface
+
+nodes flipped hit→miss (1):
+  stage 0 step 2 COPY      key bbbb2222 → cccc3333  (content changed)  miss (absent)
+      blame: src/app.py changed (stat-cache re-hash)
+
+re-chunked bytes: baseline 0B → current 16.0KiB; wire refetch: baseline 0B → current 16.0KiB
+"""
+
+
+def test_golden_explain_render():
+    assert explain.render_explain(_edited_ledger()) == GOLDEN_EXPLAIN
+
+
+def test_golden_diff_render():
+    assert explain.render_diff(_edited_ledger(),
+                               _baseline_ledger()) == GOLDEN_DIFF
+
+
+def test_diff_same_key_entry_lost():
+    """A node whose KEY did not change but whose entry evaporated
+    (eviction, KV down) renders as the entry-lost case, not a content
+    change."""
+    base = _baseline_ledger()
+    cur = _mk_ledger([
+        {"type": "cache_decision", "source": "kv", "key": "aaaa1111",
+         "verdict": "hit", "stage": "0", "step": 1, "directive": "COPY",
+         "bytes_saved": 1000},
+        {"type": "cache_decision", "source": "kv", "key": "bbbb2222",
+         "verdict": "error", "reason": "kv_error", "stage": "0",
+         "step": 2, "directive": "COPY"},
+    ])
+    text = explain.render_diff(cur, base)
+    assert "unchanged key" in text
+    assert "error (kv_error)" in text
+
+
+# -- scripted end-to-end acceptance ----------------------------------------
+
+
+@pytest.fixture
+def scripted(tmp_path, monkeypatch):
+    """Three builds of one context: cold, warm (all hit), one-file
+    edit. Returns (ledgers, reports, events logs) paths per build."""
+    # Files are written moments before building; the racily-clean
+    # window would force an honest re-hash (not a content change) on
+    # the warm build — collapse it so warm statcache probes hit.
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE_WINDOW_NS", "0")
+    ctx = tmp_path / "ctx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY a.txt /a.txt\nCOPY b.txt /b.txt\n")
+    (ctx / "a.txt").write_text("alpha\n" * 200)
+    (ctx / "b.txt").write_text("beta\n" * 400)
+    (tmp_path / "root").mkdir()
+
+    def build(n):
+        led = str(tmp_path / f"ledger{n}.jsonl")
+        rep = str(tmp_path / f"report{n}.json")
+        ev = str(tmp_path / f"events{n}.jsonl")
+        code = cli.main([
+            "--log-level", "error", "--explain-out", led,
+            "--metrics-out", rep, "--events-out", ev,
+            "build", str(ctx), "-t", "explain/test:1",
+            "--hasher", "tpu",
+            "--storage", str(tmp_path / "storage"),
+            "--root", str(tmp_path / "root")])
+        assert code == 0
+        return led, rep, ev
+
+    cold = build(1)
+    warm = build(2)
+    (ctx / "b.txt").write_text("beta\n" * 400 + "EDITED\n")
+    edited = build(3)
+    return cold, warm, edited
+
+
+def test_scripted_hit_miss_edit(scripted, capsys):
+    """The acceptance gate: on two scripted builds (identical context,
+    one edited file) `explain` names the edited file, the flipped
+    cache keys, and the re-chunked byte count."""
+    _cold, warm, edited = scripted
+    warm_ledger = ledger.read_ledger(warm[0])
+    edited_ledger = ledger.read_ledger(edited[0])
+
+    # Warm build: every KV consult hit, statcache fully hit, nothing
+    # re-chunked.
+    assert warm_ledger["header"]["schema"] == ledger.LEDGER_SCHEMA
+    kv = explain.kv_chain(warm_ledger)
+    assert kv and all(d["verdict"] == "hit" for d in kv)
+    assert warm_ledger["summary"]["statcache"]["misses"] == 0
+    assert warm_ledger["summary"]["bytes_added"] == 0
+    assert warm_ledger["summary"]["bytes_saved"] > 0
+
+    # Edited build: step 1 still hits, step 2 flipped with b.txt blame
+    # and a re-chunked layer.
+    chain = explain.kv_chain(edited_ledger)
+    verdicts = {d["step"]: d["verdict"] for d in chain}
+    assert verdicts[1] == "hit"
+    assert verdicts[2] == "miss"
+    assert edited_ledger["summary"]["statcache"]["changed_files"] \
+        == ["b.txt"]
+    assert edited_ledger["summary"]["bytes_added"] > 0
+
+    # Single-build attribution (with the floor profile).
+    assert cli.main(["explain", edited[0],
+                     "--metrics", edited[1]]) == 0
+    text = capsys.readouterr().out
+    assert "b.txt" in text
+    assert "broke the cache chain" in text
+    assert "re-chunked" in text
+    assert "warm-rebuild floor profile" in text
+    assert "irreducible floor" in text
+
+    # Build-to-build diff names the flipped node, both keys, and the
+    # edited file.
+    assert cli.main(["explain", edited[0], "--baseline", warm[0]]) == 0
+    text = capsys.readouterr().out
+    old_key = next(d["key"] for d in explain.kv_chain(warm_ledger)
+                   if d["step"] == 2)
+    new_key = next(d["key"] for d in chain if d["step"] == 2)
+    assert old_key != new_key
+    assert f"key {old_key} → {new_key}" in text
+    assert "blame: b.txt changed" in text
+
+    # An --events-out log doubles as ledger input (decisions ride the
+    # same bus).
+    from_events = ledger.read_ledger(edited[2])
+    assert ([d["key"] for d in explain.kv_chain(from_events)]
+            == [d["key"] for d in chain])
+
+
+def test_torn_ledger_salvage(scripted, capsys):
+    """A ledger torn mid-line (build killed) still loads with
+    skip_invalid and `explain` recomputes the summary."""
+    _cold, _warm, edited = scripted
+    with open(edited[0], encoding="utf-8") as f:
+        lines = f.readlines()
+    torn = edited[0] + ".torn"
+    with open(torn, "w", encoding="utf-8") as f:
+        f.writelines(lines[:-1])            # drop the summary line
+        f.write(lines[1][: len(lines[1]) // 2])  # torn partial line
+    with pytest.raises(ValueError):
+        ledger.read_ledger(torn)
+    salvaged = ledger.read_ledger(torn, skip_invalid=True)
+    assert salvaged["summary"]["recomputed"] is True
+    assert salvaged["decisions"]
+    assert cli.main(["explain", torn]) == 0
+    assert "summary recomputed" in capsys.readouterr().out
+
+
+def test_explain_rejects_non_ledger(tmp_path):
+    bogus = tmp_path / "nope.jsonl"
+    bogus.write_text('{"hello": "world"}\n')
+    with pytest.raises(SystemExit):
+        cli.main(["explain", str(bogus)])
+    # The --baseline input gets the same validation: a wrong file must
+    # error, not render a misleading "0 flips" diff.
+    real = tmp_path / "real.jsonl"
+    with open(real, "w", encoding="utf-8") as f:
+        for decision in _edited_ledger()["decisions"]:
+            f.write(json.dumps(decision) + "\n")
+    with pytest.raises(SystemExit):
+        cli.main(["explain", str(real), "--baseline", str(bogus)])
+
+
+# -- worker round-trip ------------------------------------------------------
+
+
+def test_ledger_rides_worker_event_stream(tmp_path, monkeypatch):
+    """Decisions reach a worker client as live cache_decision frames,
+    identical to the lines in the build's own --explain-out ledger;
+    /healthz carries the aggregate cache summary."""
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE_WINDOW_NS", "0")
+    server = WorkerServer(str(tmp_path / "worker.sock"))
+    thread = server.serve_background()
+    try:
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text(
+            "FROM scratch\nCOPY data.txt /data.txt\n")
+        (ctx / "data.txt").write_text("worker ledger payload\n" * 16)
+        (tmp_path / "root").mkdir()
+        client = WorkerClient(server.socket_path)
+        led = str(tmp_path / "worker-ledger.jsonl")
+        argv = ["--log-level", "error", "--explain-out", led,
+                "build", str(ctx), "-t", "worker/ledger:1",
+                "--storage", str(tmp_path / "storage"),
+                "--root", str(tmp_path / "root")]
+        assert client.build(argv) == 0
+        streamed = [e for e in client.last_events
+                    if e.get("type") == "cache_decision"]
+        on_disk = ledger.read_ledger(led)["decisions"]
+        assert streamed and streamed == on_disk
+
+        health = client.healthz()
+        cache = health["cache"]
+        assert cache["misses"] >= 1          # cold storage: a KV miss
+        assert cache["miss_reasons"].get("absent", 0) >= 1
+        assert set(cache) >= {"hits", "misses", "miss_reasons",
+                              "chunk_bytes_added", "chunk_bytes_reused",
+                              "chunk_dedup_ratio"}
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+# -- instrumentation units --------------------------------------------------
+
+
+def _collect_decisions():
+    collected = []
+
+    def sink(event):
+        if event.get("type") == "cache_decision":
+            collected.append(event)
+
+    return collected, events.add_sink(sink)
+
+
+def test_miss_reasons_kv_error_and_decode_error():
+    from makisu_tpu.cache.manager import CacheManager, CacheMiss
+
+    class _Store:
+        def __init__(self):
+            self.layers = self
+        def exists(self, hex_digest):
+            return False
+
+    class _BrokenKV:
+        def get(self, key):
+            raise ConnectionError("kv down")
+        def put(self, key, value):
+            pass
+
+    class _GarbageKV:
+        def get(self, key):
+            return "{not json"
+        def put(self, key, value):
+            pass
+
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    decisions, ev_token = _collect_decisions()
+    try:
+        mgr = CacheManager(_BrokenKV(), _Store())
+        with pytest.raises(CacheMiss):
+            mgr.pull_cache("key1")
+        mgr = CacheManager(_GarbageKV(), _Store())
+        with pytest.raises(CacheMiss):
+            mgr.pull_cache("key2")
+    finally:
+        events.reset_sink(ev_token)
+        metrics.reset_build_registry(token)
+    assert reg.counter_total("makisu_cache_miss_total",
+                             reason="kv_error") == 1
+    assert reg.counter_total("makisu_cache_miss_total",
+                             reason="decode_error") == 1
+    assert reg.counter_total("makisu_cache_pull_total",
+                             result="miss") == 2
+    assert [d["verdict"] for d in decisions] == ["error", "error"]
+    assert [d["reason"] for d in decisions] == ["kv_error",
+                                                "decode_error"]
+
+
+def test_miss_reason_stale_layer_not_local():
+    from makisu_tpu.cache.kv import MemoryStore
+    from makisu_tpu.cache.manager import (
+        CacheManager,
+        CacheMiss,
+        encode_entry,
+    )
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_LAYER,
+        Descriptor,
+        Digest,
+        DigestPair,
+    )
+
+    class _Store:
+        def __init__(self):
+            self.layers = self
+        def exists(self, hex_digest):
+            return False
+
+    pair = DigestPair(
+        tar_digest=Digest("sha256:" + "1" * 64),
+        gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, 123,
+                                   Digest("sha256:" + "2" * 64)))
+    kv = MemoryStore()
+    kv.put("key", encode_entry(pair))
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    decisions, ev_token = _collect_decisions()
+    try:
+        mgr = CacheManager(kv, _Store())  # no registry to pull from
+        with pytest.raises(CacheMiss):
+            mgr.pull_cache("key")
+    finally:
+        events.reset_sink(ev_token)
+        metrics.reset_build_registry(token)
+    assert reg.counter_total("makisu_cache_miss_total",
+                             reason="stale") == 1
+    assert decisions[0]["verdict"] == "stale"
+    assert decisions[0]["reason"] == "layer_not_local"
+
+
+def test_statcache_lookup_reasons(tmp_path, monkeypatch):
+    from makisu_tpu.utils.statcache import ContentIDCache
+
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE_WINDOW_NS", "0")
+    path = tmp_path / "f.txt"
+    path.write_text("v1")
+    cache = ContentIDCache(str(tmp_path / "ids.json"))
+    st = os.lstat(path)
+    assert cache.lookup("f.txt", st) == (None, "absent")
+    cache.put("f.txt", st, 42)
+    assert cache.lookup("f.txt", st) == (42, "hit")
+    path.write_text("v2-longer")
+    assert cache.lookup("f.txt", os.lstat(path))[1] == "stat_changed"
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE_WINDOW_NS",
+                       str(10**18))
+    assert cache.lookup("f.txt", st)[1] == "racy"
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE", "0")
+    assert cache.lookup("f.txt", st) == (None, "disabled")
+
+
+def test_chunk_cas_decision_fields(tmp_path):
+    from makisu_tpu.cache.chunks import ChunkStore
+
+    store = ChunkStore(str(tmp_path / "chunks"))
+    store.put("a" * 0 + __import__("hashlib").sha256(b"x" * 100)
+              .hexdigest(), b"x" * 100)
+    have = __import__("hashlib").sha256(b"x" * 100).hexdigest()
+    missing = "f" * 64
+    decisions, ev_token = _collect_decisions()
+    try:
+        ok = store.ensure_available(
+            [(0, 100, have), (100, 50, missing)], ledger_key="layerX")
+    finally:
+        events.reset_sink(ev_token)
+    assert not ok  # no registry attached, one chunk missing
+    [d] = decisions
+    assert d["source"] == "chunk_cas"
+    assert d["key"] == "layerX"
+    assert d["verdict"] == "miss"
+    assert d["requested"] == 2 and d["missing"] == 1
+    assert d["bytes_total"] == 150 and d["bytes_refetched"] == 0
+
+
+def test_observe_batch_matches_serial():
+    reg = metrics.MetricsRegistry()
+    serial = metrics.MetricsRegistry()
+    values = [0.5, 3.0, 100.0, 7.5, 0.0001]
+    reg.observe_batch("m", values, buckets=(1.0, 10.0))
+    for v in values:
+        serial.observe("m", v, buckets=(1.0, 10.0))
+    assert reg.report()["histograms"] == serial.report()["histograms"]
+
+
+def test_chunk_size_histogram(tmp_path):
+    from makisu_tpu.chunker.cdc import ChunkSession
+
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        session = ChunkSession()
+        session.update(os.urandom(256 * 1024))
+        chunks = session.finish()
+    finally:
+        metrics.reset_build_registry(token)
+    assert chunks
+    [hist] = reg.report()["histograms"]["makisu_chunk_size_bytes"]
+    assert hist["count"] == len(chunks)
+    assert hist["sum"] == sum(c.length for c in chunks)

@@ -15,7 +15,7 @@ import os
 import pytest
 
 from makisu_tpu import cli
-from makisu_tpu.utils import events, traceexport
+from makisu_tpu.utils import events, ledger, traceexport
 
 
 def _span_names(spans):
@@ -45,11 +45,13 @@ def test_build_metrics_out_smoke(tmp_path, out_dir):
     report_path = os.path.join(out_dir, "report.json")
     events_path = os.path.join(out_dir, "events.jsonl")
     trace_path = os.path.join(out_dir, "trace.json")
+    ledger_path = os.path.join(out_dir, "explain-ledger.jsonl")
 
     code = cli.main([
         "--metrics-out", str(report_path),
         "--events-out", str(events_path),
         "--trace-out", str(trace_path),
+        "--explain-out", str(ledger_path),
         # Forensics armed like production CI: a wedged smoke build
         # dumps a bundle into $MAKISU_TPU_DIAG_DIR (uploaded as an
         # artifact on failure) instead of dying silently.
@@ -95,6 +97,31 @@ def test_build_metrics_out_smoke(tmp_path, out_dir):
     assert event_log[-1]["exit_code"] == 0
     assert any(e["type"] == "span_start" for e in event_log)
     assert any(e["type"] == "step" for e in event_log)
+
+    # The smoke build emits a valid cache-decision ledger: header with
+    # the build's trace id, at least one decision (the cold KV consult
+    # plus the statcache walk), and a summary whose counts match the
+    # decision lines. `makisu-tpu explain` renders it (kept as a CI
+    # artifact next to the trace).
+    led = ledger.read_ledger(ledger_path)
+    assert led["header"]["schema"] == "makisu-tpu.ledger.v1"
+    assert led["header"]["trace_id"] == report["trace_id"]
+    assert led["decisions"], "ledger must record cache decisions"
+    assert {d["source"] for d in led["decisions"]} >= {"kv",
+                                                       "statcache"}
+    assert led["summary"]["decisions"] == len(led["decisions"])
+    assert led["summary"]["exit_code"] == 0
+    import contextlib
+    import io
+    explain_text = io.StringIO()
+    with contextlib.redirect_stdout(explain_text):
+        assert cli.main(["explain", ledger_path,
+                         "--metrics", report_path]) == 0
+    assert "cache chain" in explain_text.getvalue()
+    assert "warm-rebuild floor profile" in explain_text.getvalue()
+    with open(os.path.join(out_dir, "explain.txt"), "w",
+              encoding="utf-8") as f:
+        f.write(explain_text.getvalue())
 
     # The Perfetto trace loads, names the same trace id, and holds one
     # complete slice per span.
