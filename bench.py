@@ -168,6 +168,73 @@ def _device_loop_gbps(loop_fn, args, nbytes_per_iter: int,
     return nbytes_per_iter / (delta / iters) / 1e9, compile_s
 
 
+def _hash_micro(nbytes: int = 48 * 1024 * 1024) -> dict:
+    """Per-route micro-bench of the two native hot-path halves, so the
+    BENCH record attributes which half moved: gear-scan GB/s per gear
+    route (scalar / striped / avx2) and batch-SHA GB/s per sha route
+    (scalar / evp / shani), each forced via the runtime dispatch and
+    restored to auto after. Routes the host cannot run are recorded as
+    "unsupported" rather than skipped silently. Pure CPU + ctypes —
+    no JAX, safe in the parent process."""
+    import time as _time
+
+    from makisu_tpu import native
+    from makisu_tpu.ops import gear
+
+    if not native.gear_scan_available() or native.isa_route() is None:
+        return {"error": "native library (or its ISA dispatch) "
+                         "unavailable"}
+    rng = np.random.default_rng(9)
+    data = np.ascontiguousarray(
+        rng.integers(0, 256, size=nbytes, dtype=np.uint8))
+    table = np.ascontiguousarray(gear.gear_table(), dtype=np.uint32)
+    mask = (1 << gear.DEFAULT_AVG_BITS) - 1
+    out: dict = {"gear": {}, "sha": {}}
+
+    def best(fn, reps: int = 3) -> float:
+        fn()  # warm
+        secs = min(fn() for _ in range(reps))
+        return round(nbytes / secs / 1e9, 3)
+
+    def scan_once() -> float:
+        t0 = _time.perf_counter()
+        native.gear_scan_positions(data, table, mask)
+        return _time.perf_counter() - t0
+
+    # Batch SHA over ~8KiB slices of one contiguous buffer — the
+    # commit pipeline's chunk shape.
+    slice_len = 8192
+    sha_lengths = [slice_len] * (nbytes // slice_len)
+
+    def sha_once() -> float:
+        t0 = _time.perf_counter()
+        native.sha256_batch(data, sha_lengths)
+        return _time.perf_counter() - t0
+
+    lib = native._load_gear()
+    try:
+        for route in ("scalar", "striped", "avx2"):
+            if not native.isa_supported(route):
+                out["gear"][route] = "unsupported"
+                continue
+            lib.gear_set_gear_isa(route.encode())
+            out["gear"][route] = best(scan_once)
+        for route in ("scalar", "evp", "shani"):
+            if route != "scalar" and not native.isa_supported(route):
+                out["sha"][route] = "unsupported"
+                continue
+            lib.gear_set_sha_isa(route.encode())
+            # Scalar SHA is ~10x slower; one rep keeps the section fast.
+            out["sha"][route] = best(sha_once,
+                                     reps=1 if route == "scalar" else 3)
+    finally:
+        # The sweep forces PROCESS-GLOBAL routes: a failure mid-sweep
+        # must not leave the rest of the bench pinned to one.
+        native.set_native_isa("auto")
+    out["isa_route"] = native.isa_route()
+    return out
+
+
 def _native_cpu_gbps(nbytes: int = 96 * 1024 * 1024) -> dict:
     """End-to-end ChunkSession throughput on the NATIVE CPU route
     (striped C++ gear recurrence + SHA-256) — the route production
@@ -210,9 +277,16 @@ def _native_cpu_gbps(nbytes: int = 96 * 1024 * 1024) -> dict:
         default_gbps, chunks = timed(None)
     except RuntimeError as e:
         return {"native_error": str(e)}
+    from makisu_tpu import native as _native
+    route = _native.isa_route()
     out = {"native_gbps": round(default_gbps, 3),
            "native_chunks": len(chunks),
-           "native_route": "cpp-gear-striped+hashlib-sha",
+           # The runtime-dispatched SIMD route, e.g.
+           # "cpp[gear=avx2,sha=shani]"; pre-dispatch libraries report
+           # the old fixed striped+hashlib pipeline.
+           "native_route": (f"cpp[{route}]" if route
+                            else "cpp-gear-striped+hashlib-sha"),
+           "native_isa": route or "unavailable",
            "native_workers": concurrency.hash_workers()}
     # workers=1 vs workers=N sweep (best-of-2 each: the numbers feed
     # the >=2x-on-4-cores acceptance gate, so one scheduler hiccup
@@ -1038,6 +1112,7 @@ def main() -> int:
         record["value_source"] = source
     for extra in ("tiny_gbps", "tiny_timing_invalid", "big_timing_invalid",
                   "native_gbps", "native_chunks", "native_route",
+                  "native_isa",
                   "native_workers", "native_workers_sweep",
                   "native_error", "xla_cpu_gbps",
                   "init_secs", "compile_secs",
@@ -1073,6 +1148,16 @@ def main() -> int:
                  "cold_seconds") if k in ns}
         except (OSError, ValueError, TypeError):
             pass
+    # Hash micro-section: gear-scan and batch-SHA GB/s per ISA route,
+    # so the record attributes which half of the hot path moved (and
+    # names the dispatched route in the bench tail). Pure CPU.
+    try:
+        record["hash_micro"] = _hash_micro()
+        if "isa_route" in record["hash_micro"]:
+            record.setdefault("native_isa",
+                              record["hash_micro"]["isa_route"])
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["hash_micro"] = {"error": str(e)[:200]}
     # Wire-plane micro-section: the parallel-vs-serial 8-layer pull
     # tracks the transfer engine's overlap win round over round,
     # independent of any accelerator.

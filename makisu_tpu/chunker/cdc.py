@@ -35,8 +35,8 @@ SURVEY.md §5 "long-context" mapping.
 from __future__ import annotations
 
 import contextvars
-import dataclasses
 import time
+import typing
 
 import jax
 import numpy as np
@@ -82,7 +82,8 @@ def reset_chunk_observer(token) -> None:
 
 
 def _native_cpu_route() -> bool:
-    """Whether this process should chunk natively (C++ gear + hashlib)
+    """Whether this process should chunk natively (runtime-dispatched
+    C++ gear scan + batch SHA-256, makisu_tpu/native.py ISA ladder)
     instead of driving the JAX backend: only when that backend IS the
     CPU — same math, ~10x less overhead — never on a real accelerator.
     MAKISU_TPU_CHUNK_NATIVE=0 forces the XLA route (A/B, debugging)."""
@@ -115,8 +116,11 @@ def _sha_batch_route() -> bool:
 _BUCKETS = ((16 * 1024, 512), (gear.DEFAULT_MAX_SIZE + 64, 128))
 
 
-@dataclasses.dataclass(frozen=True)
-class Chunk:
+class Chunk(typing.NamedTuple):
+    # NamedTuple, not a frozen dataclass: sessions create one per
+    # ~8KiB chunk (~130k/GB), and tuple construction is ~5x cheaper
+    # than frozen-dataclass __setattr__ — measurable on the native
+    # serial route. Field access is unchanged.
     offset: int
     length: int
     digest: bytes  # 32-byte sha256
@@ -210,11 +214,11 @@ class ChunkSession:
         self._batchers = [_LaneBatcher(cap, lanes)
                           for cap, lanes in _BUCKETS]
         self._chunks: list[Chunk] = []
-        # Pooled-route state, defaulted before the backend probe below
-        # (whose _degrade clears them). The batch buffer is assembled
-        # on the producer thread (which owns the GIL anyway); worker
-        # tasks are a single GIL-released native call.
-        self._sha_buf = bytearray()
+        # Batched-route state, defaulted before the backend probe below
+        # (whose _degrade clears them). Pending chunks are (offset,
+        # length) records tiling the tail's prefix [_tail_offset,
+        # _prev_cut); the flush consumes that prefix in one slice and
+        # one GIL-released native call.
         self._sha_meta: list[tuple[int, int]] = []  # (offset, length)
         self._sha_pending: list = []  # ordered (meta, Future->digests)
         self._degraded: str | None = None  # failure summary once degraded
@@ -227,11 +231,11 @@ class ChunkSession:
         if err is not None:
             self._degrade("backend init", RuntimeError(err))
         # CPU hosts (build boxes with no accelerator) take the native
-        # route: a striped C++ gear recurrence + hashlib digests,
-        # bit-identical to the device formulation and ~10x driving
-        # XLA's CPU backend through the vector form. The service path
-        # (cross-build device batching) and non-cpu backends keep the
-        # device route.
+        # route: the runtime-dispatched C++ gear scan (AVX2 / striped /
+        # scalar) + batch SHA-256 (SHA-NI / EVP / scalar), bit-identical
+        # to the device formulation and ~10x driving XLA's CPU backend
+        # through the vector form. The service path (cross-build device
+        # batching) and non-cpu backends keep the device route.
         self._native = (self._degraded is None and service is None
                         and _native_cpu_route())
         # The gear table is deterministic by contract; one copy per
@@ -252,6 +256,11 @@ class ChunkSession:
         self._depth = self.PIPELINE_DEPTH
         self._pool = None
         self._sha_slots = None
+        # Serial native route ALSO batches chunk SHA when the native
+        # batch hasher exists: one GIL-released call per ~MiB batch
+        # (SHA-NI multi-buffer when the CPU has it) instead of ~128
+        # per-chunk hashlib round trips — same digests, same order.
+        self._sha_sync = False
         if self._native:
             self._workers = (concurrency.hash_workers()
                              if workers is None else max(1, workers))
@@ -270,6 +279,8 @@ class ChunkSession:
                     self._workers)
                 self._sha_depth = 0
                 self._sha_depth_lock = threading.Lock()
+            if self._pool is None:
+                self._sha_sync = _sha_batch_route()
 
     # -- failure discipline ----------------------------------------------
 
@@ -296,10 +307,9 @@ class ChunkSession:
         self._inflight = []
         self._chunks = []
         self._service_pending = []
-        # Pooled-route state: pending tasks complete harmlessly on the
+        # Batched-route state: pending tasks complete harmlessly on the
         # shared pool (they release their own slots); just drop the
         # references so their buffers free.
-        self._sha_buf = bytearray()
         self._sha_meta = []
         self._sha_pending = []
         for b in self._batchers:
@@ -313,23 +323,33 @@ class ChunkSession:
             return
         self._staging.extend(data)
         while len(self._staging) >= self.block:
-            blk = bytes(self._staging[:self.block])
+            # The scan buffer is assembled ONCE with the halo prefix in
+            # place (join accepts the staging memoryview directly): one
+            # copy instead of three (bytearray slice → bytes() → the
+            # old per-scan halo+blk concat) — a full stream pass saved
+            # on every route.
+            halo_len = len(self._halo)
+            with memoryview(self._staging) as mv:
+                hblk = b"".join((self._halo, mv[:self.block]))
             del self._staging[:self.block]
             try:
                 # (the dispatch also drains the oldest in-flight block
                 # when the pipeline is full, so readback errors can
                 # surface here too — hence the broader stage label)
-                self._dispatch_block(blk)
+                self._dispatch_block(hblk, halo_len, self.block)
             except Exception as e:  # noqa: BLE001 - device plane
                 self._degrade("gear pipeline", e)
                 return
 
     def finish(self) -> list[Chunk]:
         if self._degraded is None and self._staging:
-            blk = bytes(self._staging)
-            pad = (-len(blk)) % 32
+            live = len(self._staging)
+            pad = (-live) % 32  # exactly the pre-halo-prefix padding
+            halo_len = len(self._halo)
+            with memoryview(self._staging) as mv:
+                hblk = b"".join((self._halo, mv, b"\x00" * pad))
             try:
-                self._dispatch_block(blk + b"\x00" * pad, live=len(blk))
+                self._dispatch_block(hblk, halo_len, live)
             except Exception as e:  # noqa: BLE001 - device plane
                 self._degrade("gear pipeline", e)
             self._staging.clear()
@@ -338,21 +358,26 @@ class ChunkSession:
                 self._process_block(self._inflight.pop(0))
             except Exception as e:  # noqa: BLE001 - device plane
                 self._degrade("gear readback", e)
-        # Final chunk: whatever follows the last cut.
-        if self._degraded is None and self._tail:
-            try:
-                self._emit(bytes(self._tail), self._tail_offset)
-            except Exception as e:  # noqa: BLE001 - device plane
-                self._degrade("lane dispatch", e)
-            self._tail.clear()
+        # Final chunk: whatever follows the last cut. _take routes it
+        # like any forced cut — straight to the batch record on the
+        # batched routes (the tail may still hold pending batch bytes,
+        # so it must NOT be cleared here), immediate emit elsewhere.
+        if self._degraded is None:
+            stream_end = self._tail_offset + len(self._tail)
+            if stream_end > self._prev_cut:
+                try:
+                    self._take(stream_end)
+                except Exception as e:  # noqa: BLE001 - device plane
+                    self._degrade("lane dispatch", e)
         if self._degraded is None:
             try:
-                if self._pool is not None:
+                if self._pool is not None or self._sha_sync:
                     self._flush_sha_batch()
+                if self._pool is not None:
                     for meta, fut in self._sha_pending:
-                        digests = fut.result()
+                        raw = fut.result().tobytes()
                         self._chunks.extend(
-                            Chunk(off, n, digests[i].tobytes())
+                            Chunk(off, n, raw[32 * i:32 * i + 32])
                             for i, (off, n) in enumerate(meta))
                     self._sha_pending = []
                 for b in self._batchers:
@@ -396,13 +421,18 @@ class ChunkSession:
 
     # -- internals --------------------------------------------------------
 
-    def _dispatch_block(self, blk: bytes, live: int | None = None) -> None:
+    def _dispatch_block(self, hblk: bytes, halo_len: int,
+                        live: int) -> None:
         """Ship one block to the scan stage (device dispatch, or the
         commit pool on the multicore native route); process the oldest
-        in-flight block when the pipeline is full."""
+        in-flight block when the pipeline is full.
+
+        ``hblk`` arrives with the previous block's halo already in
+        place (``hblk[:halo_len]``) and the live stream bytes at
+        ``hblk[halo_len:halo_len + live]`` (anything after is zero
+        padding on the final block) — assembled once by the caller, so
+        no scan route re-concatenates the 4MiB buffer."""
         from makisu_tpu.ops import gear_pallas
-        live = len(blk) if live is None else live
-        halo = self._halo
         entry = None
         scan_backend = None  # executing backend when != entry[0]'s tag
         if self._native:
@@ -413,8 +443,9 @@ class ChunkSession:
                 # across the pool while _process_block consumes results
                 # in stream order. Boundaries are byte-identical.
                 fut = concurrency.submit_ctx(
-                    self._pool, self._scan_task, halo, blk, live)
-                entry = ("native", fut, None, live, blk, self._scanned)
+                    self._pool, self._scan_task, hblk, halo_len, live)
+                entry = ("native", fut, halo_len, live, hblk,
+                         self._scanned)
                 metrics.stage_queue_depth("gear_scan",
                                           len(self._inflight) + 1)
             else:
@@ -423,10 +454,10 @@ class ChunkSession:
                 # The C++ scan returns candidate POSITIONS directly —
                 # no bit array, no host-side nonzero rescan.
                 entry = ("native",
-                         self._scan_positions(halo, blk, live), None,
-                         live, blk, self._scanned)
+                         self._scan_positions(hblk, halo_len, live),
+                         halo_len, live, hblk, self._scanned)
         if entry is None:
-            buf = np.frombuffer(halo + blk, dtype=np.uint8)
+            buf = np.frombuffer(hblk, dtype=np.uint8)
         if entry is None and gear_pallas.v2_enabled():
             # Opt-in natural-layout kernel (MAKISU_TPU_PALLAS_V2=1):
             # pure-reshape staging, full-buffer bitmap (XLA-contract
@@ -444,7 +475,7 @@ class ChunkSession:
                     interpret=jax.default_backend() == "cpu")
                 # entry[0] is the READBACK layout tag (v2 words decode
                 # like XLA's), not the executing backend.
-                entry = ("xla", words, len(halo), live, blk,
+                entry = ("xla", words, halo_len, live, hblk,
                          self._scanned)
                 scan_backend = "pallas_v2"
             except Exception as e:  # noqa: BLE001 - kernel plane
@@ -458,56 +489,55 @@ class ChunkSession:
             # live region is zero-padded to the kernel's 64 KiB row-grid
             # granularity so distinct tail-block sizes share compiles.
             try:
-                start = len(halo)
                 words = gear_pallas.gear_bitmap_flat(
-                    gear_pallas.quantize_flat(buf, start, live), start,
-                    self.avg_bits,
+                    gear_pallas.quantize_flat(buf, halo_len, live),
+                    halo_len, self.avg_bits,
                     interpret=jax.default_backend() == "cpu")
                 entry = ("pallas", words, gear_pallas.nrows_for(live),
-                         live, blk, self._scanned)
+                         live, hblk, self._scanned, halo_len)
             except Exception as e:  # noqa: BLE001 - kernel plane
                 gear_pallas.mark_broken(e)
         if entry is None:
             words = gear.gear_bitmap(buf, self.avg_bits)  # async dispatch
-            entry = ("xla", words, len(halo), live, blk, self._scanned)
+            entry = ("xla", words, halo_len, live, hblk, self._scanned)
         if scan_backend is None:
             scan_backend = entry[0]
         metrics.counter_add("makisu_gear_scan_bytes_total", live,
                             backend=scan_backend)
         self._inflight.append(entry)
         self._scanned += live
-        # Next block's halo, computed without re-concatenating the
-        # whole 4MiB buffer (byte-identical to (halo+blk)[-HALO:]).
-        if len(blk) >= gear_pallas.HALO:
-            self._halo = blk[-gear_pallas.HALO:]
-        else:
-            self._halo = (halo + blk)[-(gear_pallas.HALO):]
+        # Next block's halo: the last HALO live bytes (padding excluded;
+        # byte-identical to the old (halo+blk)[-HALO:]).
+        end = halo_len + live
+        self._halo = hblk[max(0, end - gear_pallas.HALO):end]
         while len(self._inflight) > self._depth:
             self._process_block(self._inflight.pop(0))
 
-    def _scan_positions(self, halo: bytes, blk: bytes, live: int):
+    def _scan_positions(self, hblk: bytes, halo_len: int, live: int):
         """Candidate positions for one block (native C++ scan): the
         shared math of the synchronous and pooled routes — positions
-        over halo+blk, trimmed to the live region, halo-relative."""
+        over the halo-prefixed buffer, trimmed to the live region,
+        halo-relative."""
         from makisu_tpu import native
-        buf = np.frombuffer(halo + blk, dtype=np.uint8)
+        buf = np.frombuffer(hblk, dtype=np.uint8)
         pos = native.gear_scan_positions(
             buf, self._table, (1 << self.avg_bits) - 1)
-        lo = np.searchsorted(pos, len(halo))
-        hi = np.searchsorted(pos, len(halo) + live)
-        return pos[lo:hi] - len(halo)
+        lo = np.searchsorted(pos, halo_len)
+        hi = np.searchsorted(pos, halo_len + live)
+        return pos[lo:hi] - halo_len
 
-    def _scan_task(self, halo: bytes, blk: bytes, live: int):
+    def _scan_task(self, hblk: bytes, halo_len: int, live: int):
         t0 = time.monotonic()
         try:
-            return self._scan_positions(halo, blk, live)
+            return self._scan_positions(hblk, halo_len, live)
         finally:
             metrics.stage_busy_add("gear_scan", time.monotonic() - t0)
 
     def _process_block(self, entry: tuple) -> None:
         """Read back one block's bitmap (bounded sync) and cut chunks."""
-        kind, words, meta, live, blk, base = entry
+        kind, words, meta, live, hblk, base = entry[:6]
         if kind == "native":
+            halo_len = meta
             if hasattr(words, "result"):
                 # Pooled scan: block until THIS block's candidates are
                 # in (stream order preserved; a task error propagates
@@ -519,6 +549,7 @@ class ChunkSession:
             host_words = _backend.sync_bounded(
                 words, "gear bitmap readback")
             nrows = meta
+            halo_len = entry[6]
             bits = gear.unpack_bits_np(
                 host_words[:nrows], nrows * gear_pallas.ROW)
             candidates = np.nonzero(
@@ -530,14 +561,18 @@ class ChunkSession:
             bits = gear.unpack_bits_np(
                 host_words, halo_len + live)[halo_len:halo_len + live]
             candidates = np.nonzero(bits)[0] + base
-        self._tail.extend(blk[:live])
+        with memoryview(hblk) as mv:
+            self._tail.extend(mv[halo_len:halo_len + live])
         # tolist(): one C conversion instead of a numpy-scalar __int__
         # per candidate on the producer's critical path.
         for pos in candidates.tolist():
             self._cut_to(pos + 1)  # cut AFTER the boundary byte
-        # Oversize tail without candidates: force max-size cuts.
-        while len(self._tail) > self.max_size:
-            self._force_cut(self._tail_offset + self.max_size)
+        # Oversize uncut span without candidates: force max-size cuts.
+        # (Measured from the last cut, not the tail start — on the
+        # batched routes the tail also holds pending batch bytes.)
+        while (self._tail_offset + len(self._tail) - self._prev_cut
+               > self.max_size):
+            self._force_cut(self._prev_cut + self.max_size)
 
     def _cut_to(self, end: int) -> None:
         if end - self._prev_cut < self.min_size:
@@ -551,27 +586,33 @@ class ChunkSession:
         self._take(end)
 
     def _take(self, end: int) -> None:
-        n = end - self._tail_offset
+        n = end - self._prev_cut
         if n <= 0:
             return
-        if self._pool is not None and self._degraded is None:
-            # Pooled fast path: chunk bytes copy ONCE, straight from
-            # the tail into the batch buffer (the generic path below
-            # would copy twice more — slice, then bytes()). The
-            # memoryview must close before the del: a bytearray with
-            # an exported buffer cannot resize.
-            with memoryview(self._tail) as mv:
-                self._sha_buf += mv[:n]
-            del self._tail[:n]
+        if ((self._pool is not None or self._sha_sync)
+                and self._degraded is None):
+            # Batched fast path (pooled AND serial-native): no per-chunk
+            # byte shuffling at all. Chunks tile the stream, so the
+            # pending batch IS the tail's prefix [_tail_offset,
+            # _prev_cut) — _take just records (offset, length) and the
+            # flush consumes that prefix in ONE slice + ONE native call
+            # (the old per-chunk memoryview copies were ~2s/GB of pure
+            # Python on the serial route).
+            self._sha_meta.append((self._prev_cut, n))
             self._native_hashed += n
-            self._sha_meta.append((self._tail_offset, n))
-            if len(self._sha_buf) >= SHA_BATCH_BYTES:
+            self._prev_cut = end
+            if end - self._tail_offset >= SHA_BATCH_BYTES:
                 self._flush_sha_batch()
-        else:
-            with memoryview(self._tail) as mv:
-                data = bytes(mv[:n])
-            del self._tail[:n]
-            self._emit(data, self._tail_offset)
+            return
+        # Immediate path (device lanes / service / per-chunk hashlib):
+        # nothing defers here, so the tail starts at the chunk start
+        # (_prev_cut == _tail_offset) and is consumed chunk by chunk.
+        # The memoryview must close before the del: a bytearray with an
+        # exported buffer cannot resize.
+        with memoryview(self._tail) as mv:
+            data = bytes(mv[:n])
+        del self._tail[:n]
+        self._emit(data, self._tail_offset)
         self._tail_offset = end
         self._prev_cut = end
 
@@ -589,10 +630,40 @@ class ChunkSession:
     def _flush_sha_batch(self) -> None:
         if not self._sha_meta:
             return
-        buf = self._sha_buf  # zero-copy handoff; fresh buffer below
         meta = self._sha_meta
-        self._sha_buf = bytearray()
         self._sha_meta = []
+        # The batch is the tail prefix the recorded chunks tile:
+        # [_tail_offset, _prev_cut) in stream coordinates.
+        consumed = self._prev_cut - self._tail_offset
+        lengths = [n for _, n in meta]
+        if self._pool is None:
+            # Serial native route: hash the batch NOW — ONE
+            # GIL-released native call (runtime-dispatched: SHA-NI
+            # multi-buffer / EVP / scalar) straight out of the tail
+            # buffer, zero-copy (nothing mutates the tail during a
+            # synchronous call). Digests are byte-identical to hashlib.
+            from makisu_tpu import native
+            with memoryview(self._tail) as mv:
+                digests = native.sha256_batch(mv[:consumed], lengths)
+            del self._tail[:consumed]
+            self._tail_offset = self._prev_cut
+            raw = digests.tobytes()  # ONE copy; bytes slicing is cheap
+            if self._observer is None:
+                self._chunks.extend(
+                    Chunk(off, n, raw[32 * i:32 * i + 32])
+                    for i, (off, n) in enumerate(meta))
+            else:
+                for i, (off, n) in enumerate(meta):
+                    digest = raw[32 * i:32 * i + 32]
+                    self._chunks.append(Chunk(off, n, digest))
+                    self._notify(digest.hex())
+            return
+        # Pooled route: copy the prefix ONCE into the task's own buffer
+        # (the producer keeps mutating the tail while the task runs).
+        with memoryview(self._tail) as mv:
+            buf = bytes(mv[:consumed])
+        del self._tail[:consumed]
+        self._tail_offset = self._prev_cut
         self._sha_slots.acquire()  # released by the task (backpressure)
         with self._sha_depth_lock:
             self._sha_depth += 1
@@ -600,7 +671,7 @@ class ChunkSession:
         metrics.stage_queue_depth("chunk_sha", depth)
         self._sha_pending.append(
             (meta, concurrency.submit_ctx(self._pool, self._sha_task,
-                                          buf, [n for _, n in meta])))
+                                          buf, lengths)))
 
     def _sha_task(self, buf: bytes, lengths: list[int]):
         """Pool-side chunk hashing: ONE GIL-released native call for
@@ -608,7 +679,8 @@ class ChunkSession:
         OpenSSL underneath). Deliberately does nothing else: every
         extra GIL acquisition on a pool thread can stall a full switch
         interval behind the GIL-bound producer, so batch assembly
-        happens in _emit and Chunk objects are built at finish()."""
+        happens in _take/_flush_sha_batch and Chunk objects are built
+        at finish()."""
         from makisu_tpu import native
         t0 = time.monotonic()
         try:
@@ -625,21 +697,12 @@ class ChunkSession:
 
     def _emit(self, data: bytes, offset: int) -> None:
         if self._native:
-            # hashlib IS the native SHA-256 (OpenSSL, SHA-NI): no lane
-            # batching to amortize on a CPU host. Bytes-hashed totals
-            # accumulate locally and flush once at finish().
-            self._native_hashed += len(data)
-            if self._pool is not None:
-                # Multicore route: chunk bytes accumulate into one
-                # contiguous batch buffer and hash on the pool;
-                # finish() drains the futures in submit (= stream)
-                # order.
-                self._sha_buf += data
-                self._sha_meta.append((offset, len(data)))
-                if len(self._sha_buf) >= SHA_BATCH_BYTES:
-                    self._flush_sha_batch()
-                return
+            # Per-chunk hashlib: the no-batch-symbol fallback (a stale
+            # library without gear_sha256_batch). The batched routes
+            # never reach here — _take records chunks for the prefix
+            # flush instead of materializing per-chunk bytes.
             import hashlib
+            self._native_hashed += len(data)
             digest = hashlib.sha256(data).digest()
             self._chunks.append(Chunk(offset, len(data), digest))
             self._notify(digest.hex())

@@ -730,6 +730,15 @@ def main(argv: list[str] | None = None) -> int:
     # Deploy-identity info gauge: constant 1, identity in the labels
     # (the node_exporter "build_info" idiom). Scrapers join it against
     # rate() series to slice by version/hasher/platform/mode.
+    # native_isa: the runtime-dispatched SIMD route of the layer-commit
+    # hot path (native.py), e.g. "gear=avx2,sha=shani" — resolved once
+    # per process and NEVER part of cache identity (every route emits
+    # identical bytes). Only CPU-backend builds force the native
+    # library load (the only case the gear route engages); everything
+    # else labels whatever is already resolved — an accelerator build
+    # must not pay a synchronous `make -C native` for a telemetry
+    # label.
+    from makisu_tpu import native as _native
     metrics.gauge_set(
         "makisu_build_info", 1,
         version=makisu_tpu.__version__,
@@ -738,7 +747,12 @@ def main(argv: list[str] | None = None) -> int:
         platform=os.environ.get("JAX_PLATFORMS", "") or "default",
         mode=invocation_mode.get(),
         hash_workers=concurrency.hash_workers(),
-        hash_linger_ms=concurrency.hash_linger_ms())
+        hash_linger_ms=concurrency.hash_linger_ms(),
+        native_isa=(_native.isa_label()
+                    if args.command == "build"
+                    and os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                    else (_native.isa_route_if_resolved()
+                          or "unresolved")))
     # Failure forensics: every invocation arms a flight recorder (a
     # lock-free ring of recent events/log records) and the process
     # resource sampler. Cost when nothing goes wrong: one deque append

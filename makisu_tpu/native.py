@@ -10,7 +10,16 @@ back cleanly when the shared library hasn't been built; callers check
 deterministic gzip in one C++ pass, replacing Python-side byte shuffling
 on the hot path (reference: lib/builder/step/common.go:35-64).
 
-Build: ``make -C native`` (g++ + zlib; no extra dependencies).
+ISA dispatch: libgear.so resolves its gear-scan route (avx2 / striped /
+scalar) and SHA-256 batch route (shani / evp / scalar) once per
+process from CPUID — one binary serves every host. The
+``MAKISU_TPU_NATIVE_ISA`` env knob (read here at load) caps the
+ladder; ``set_native_isa`` forces it in-process (tests/bench). Every
+route emits byte-identical positions and digests: ISA is a throughput
+knob and never enters cache identity.
+
+Build: ``make -C native`` (g++ + zlib; no extra dependencies — SIMD
+flags are probed per translation unit, see native/Makefile).
 """
 
 from __future__ import annotations
@@ -43,15 +52,64 @@ _TAP_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_uint8),
                            ctypes.c_size_t, ctypes.c_void_p)
 
 
+# What each library is built from (mirrors the Makefile rules): the
+# staleness gate below must only compare a library against ITS inputs,
+# or every rebuild of one library would smear false STALE errors over
+# the others.
+_LIB_SOURCES = {
+    "libpgzip.so": ("pgzip.cpp", "deflate_common.h"),
+    "liblayersink.so": ("layersink.cpp", "deflate_common.h",
+                        "sha256_common.h"),
+    "libgear.so": ("gear.cpp", "gear_simd.cpp", "sha_ni.cpp",
+                   "gear_isa.h", "sha256_common.h"),
+}
+
+
 def _ensure_built(lib_path: str) -> bool:
     """Run make (mtime-based, so stale .so files rebuild — their output
     bytes are cache identity) and report whether the library exists."""
+    made = False
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=120)
+        made = True
     except (OSError, subprocess.SubprocessError):
         pass  # no toolchain: a prebuilt library is still usable
-    return os.path.isfile(lib_path)
+    if not os.path.isfile(lib_path):
+        return False
+    if not made:
+        # make could not run (or failed): the prebuilt library may
+        # predate the sources — shout rather than silently serve old
+        # routes. When make DID run, mtime-driven rebuilds are its job.
+        _warn_if_stale(lib_path)
+    return True
+
+
+def _warn_if_stale(lib_path: str) -> None:
+    """Loud staleness gate (CI has hit silent-stale .so confusion): if
+    any of THIS library's sources is newer than the built library and
+    make could not fix it (no toolchain, or a swallowed build failure),
+    say so in the log instead of silently serving old routes.
+    Correctness is unaffected — every route emits identical bytes — so
+    this warns rather than refuses; an ABI mismatch (checked at load)
+    refuses."""
+    sources = _LIB_SOURCES.get(os.path.basename(lib_path), ())
+    try:
+        lib_mtime = os.path.getmtime(lib_path)
+        stale = [
+            name for name in sources
+            if os.path.isfile(os.path.join(_NATIVE_DIR, name))
+            and os.path.getmtime(os.path.join(_NATIVE_DIR, name))
+            > lib_mtime]
+    except OSError:
+        return
+    if stale:
+        from makisu_tpu.utils import logging as log
+        log.error(
+            "%s is STALE vs %s and `make -C native` did not rebuild it "
+            "— run `make -C native clean all` (or `make -C native "
+            "check` to verify)", os.path.basename(lib_path),
+            ", ".join(sorted(stale)))
 
 
 def _load() -> ctypes.CDLL | None:
@@ -134,10 +192,40 @@ def layersink_available() -> bool:
 _gear_lib: ctypes.CDLL | None = None
 _gear_failed = False
 _gear_sha_batch = False
+_gear_pos2 = False
+_isa_route: tuple[str, str] | None = None  # resolved (gear, sha) names
+
+# Combined ISA ladder the MAKISU_TPU_NATIVE_ISA knob selects from. Each
+# level caps BOTH halves of the hot path; "auto" (the default) resolves
+# to the best the CPU/build supports. ISA is a throughput knob only:
+# cut positions and digests are byte-identical at every level, so it
+# must NEVER enter cache identity.
+ISA_LEVELS = ("auto", "scalar", "striped", "simd")
+_ISA_MAP = {
+    # level: (gear route preference order, sha route preference order)
+    "scalar": (("scalar",), ("scalar",)),
+    "striped": (("striped",), ("evp", "scalar")),
+    "simd": (("avx2", "striped"), ("shani", "evp", "scalar")),
+    "auto": (("auto",), ("auto",)),
+}
+
+
+def _apply_isa(lib: ctypes.CDLL, level: str) -> tuple[str, str]:
+    """Set both route halves for ``level`` (first supported preference
+    wins) and return the resolved (gear, sha) route names."""
+    gear_prefs, sha_prefs = _ISA_MAP[level]
+    for name in gear_prefs:
+        if lib.gear_set_gear_isa(name.encode()) == 0:
+            break
+    for name in sha_prefs:
+        if lib.gear_set_sha_isa(name.encode()) == 0:
+            break
+    return (lib.gear_gear_isa().decode(), lib.gear_sha_isa().decode())
 
 
 def _load_gear() -> ctypes.CDLL | None:
-    global _gear_lib, _gear_failed, _gear_sha_batch
+    global _gear_lib, _gear_failed, _gear_sha_batch, _gear_pos2
+    global _isa_route
     with _lock:
         if _gear_lib is not None or _gear_failed:
             return _gear_lib
@@ -173,7 +261,63 @@ def _load_gear() -> ctypes.CDLL | None:
             _gear_sha_batch = True
         except AttributeError:
             _gear_sha_batch = False
+        try:
+            # ABI-2 surface: runtime ISA dispatch. A stale pre-SIMD
+            # library still serves the striped routes above; it just
+            # has no dispatch to introspect — the staleness gate in
+            # _ensure_built already shouted about it.
+            if lib.gear_abi_version() != 2:
+                raise OSError("libgear ABI mismatch")
+            lib.gear_scan_pos2.restype = ctypes.c_int
+            lib.gear_scan_pos2.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t]
+            for fn in (lib.gear_set_gear_isa, lib.gear_set_sha_isa,
+                       lib.gear_isa_supported):
+                fn.restype = ctypes.c_int
+                fn.argtypes = [ctypes.c_char_p]
+            lib.gear_gear_isa.restype = ctypes.c_char_p
+            lib.gear_gear_isa.argtypes = []
+            lib.gear_sha_isa.restype = ctypes.c_char_p
+            lib.gear_sha_isa.argtypes = []
+            _gear_pos2 = True
+        except (OSError, AttributeError) as e:
+            from makisu_tpu.utils import logging as log
+            log.error(
+                "libgear.so predates the SIMD dispatch ABI (%s); "
+                "serving the striped routes only — run "
+                "`make -C native clean all` to rebuild", e)
+            _gear_pos2 = False
+        if _gear_pos2:
+            level = os.environ.get("MAKISU_TPU_NATIVE_ISA", "auto")
+            if level not in _ISA_MAP:
+                from makisu_tpu.utils import logging as log
+                log.warning(
+                    "unknown MAKISU_TPU_NATIVE_ISA=%r (valid: %s); "
+                    "using auto", level, "/".join(ISA_LEVELS))
+                level = "auto"
+            _isa_route = _apply_isa(lib, level)
+            _note_isa_route(level)
         return _gear_lib
+
+
+def _note_isa_route(level: str) -> None:
+    """Log the resolved route once per process and publish the
+    per-route ``makisu_native_isa`` info gauge (process-global, so a
+    worker's /metrics carries it; the per-build ``makisu_build_info``
+    gauge carries the same string as a label)."""
+    from makisu_tpu.utils import logging as log
+    from makisu_tpu.utils import metrics
+    gear_r, sha_r = _isa_route  # built inline: _lock is held here
+    log.info("native ISA route resolved: gear=%s sha=%s (knob=%s)",
+             gear_r, sha_r, level)
+    try:
+        metrics.global_registry().gauge_set(
+            "makisu_native_isa", 1, route=f"gear={gear_r},sha={sha_r}")
+    except Exception:  # noqa: BLE001 - telemetry must not fail loads
+        pass
 
 
 def gear_scan_available() -> bool:
@@ -184,6 +328,65 @@ def sha_batch_available() -> bool:
     return _load_gear() is not None and _gear_sha_batch
 
 
+def isa_route() -> str | None:
+    """The resolved ISA route string, e.g. ``"gear=avx2,sha=shani"`` —
+    what the build_info label and the bench record carry. None when the
+    native library (or its dispatch ABI) is unavailable."""
+    if _load_gear() is None or _isa_route is None:
+        return None
+    return f"gear={_isa_route[0]},sha={_isa_route[1]}"
+
+
+def isa_label() -> str:
+    """``isa_route()`` for metric labels: never None."""
+    return isa_route() or "unavailable"
+
+
+def isa_route_if_resolved() -> str | None:
+    """Like :func:`isa_route` but never forces the library load —
+    for telemetry on commands that may not touch the hash path."""
+    if _isa_route is None:
+        return None
+    return f"gear={_isa_route[0]},sha={_isa_route[1]}"
+
+
+def set_native_isa(level: str) -> str | None:
+    """Force an ISA level in-process (tests / bench sweeps). ``level``
+    is one of ISA_LEVELS; returns the resolved route string. The
+    MAKISU_TPU_NATIVE_ISA env knob applies the same mapping once at
+    library load."""
+    global _isa_route
+    if level not in _ISA_MAP:
+        raise ValueError(f"unknown ISA level {level!r}; "
+                         f"valid: {'/'.join(ISA_LEVELS)}")
+    lib = _load_gear()
+    if lib is None or not _gear_pos2:
+        return None
+    old = isa_route()
+    _isa_route = _apply_isa(lib, level)
+    new = isa_route()
+    if new != old:
+        # Keep the per-route info gauge tracking the LIVE route: the
+        # old series drops to 0 so a scraper never sees two routes at 1.
+        try:
+            from makisu_tpu.utils import metrics
+            reg = metrics.global_registry()
+            if old is not None:
+                reg.gauge_set("makisu_native_isa", 0, route=old)
+            reg.gauge_set("makisu_native_isa", 1, route=new)
+        except Exception:  # noqa: BLE001 - telemetry plane
+            pass
+    return new
+
+
+def isa_supported(name: str) -> bool:
+    """Whether this host/build can run a specific route half
+    ("avx2", "shani", "evp", "striped", "scalar")."""
+    lib = _load_gear()
+    return bool(lib is not None and _gear_pos2
+                and lib.gear_isa_supported(name.encode()))
+
+
 def sha256_batch(buf, lengths):
     """SHA-256 each slice of ``buf`` (slice i covers
     ``[sum(lengths[:i]), sum(lengths[:i+1]))``); returns an
@@ -191,7 +394,8 @@ def sha256_batch(buf, lengths):
     batch — the GIL is released end to end, which is what lets pooled
     chunk hashing scale past the per-call GIL ping-pong that per-chunk
     hashlib suffers at ~8KiB sizes. Digests are byte-identical to
-    hashlib (same OpenSSL via EVP; audited scalar fallback)."""
+    hashlib on every dispatched route (SHA-NI multi-buffer / OpenSSL
+    EVP / audited scalar fallback)."""
     import numpy as np
 
     lib = _load_gear()
@@ -239,9 +443,13 @@ def gear_scan_bits(buf, table, mask: int):
 def gear_scan_positions(buf, table, mask: int):
     """Boundary-candidate POSITIONS for ``buf`` — same predicate as
     gear_scan_bits with no bit-array materialization or host rescan.
-    Returns a sorted np.uint32 array. Capacity is 4x the expected hit
-    rate; the (adversarial-data) overflow case falls back to the bit
-    scan, so the result is always complete."""
+    Returns a sorted np.uint32 array. Slot capacity is ~several-x the
+    expected hit rate; the (adversarial-data) overflow case falls back
+    to the bit scan, so the result is always complete.
+
+    Routes through the library's runtime ISA dispatch (gear_scan_pos2,
+    8 output slots so the AVX2 kernel's 8 lanes map 1:1); a stale
+    pre-dispatch library serves the classic 4-slot striped entry."""
     import numpy as np
 
     lib = _load_gear()
@@ -251,22 +459,33 @@ def gear_scan_positions(buf, table, mask: int):
     table = np.ascontiguousarray(table, dtype=np.uint32)
     n = len(buf)
     expected = n // max(mask, 1) + 1
-    stripe_cap = max(64, expected)  # 4 stripes x ~4x margin overall
-    out = np.empty(4 * stripe_cap, dtype=np.uint32)
-    counts = np.zeros(4, dtype=np.uint32)
-    rc = lib.gear_scan_pos(
-        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
-        table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        ctypes.c_uint32(mask),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        stripe_cap,
-        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    nslots = 8 if _gear_pos2 else 4
+    slot_cap = max(64, expected)  # per-slot ~nslots-x margin overall
+    out = np.empty(nslots * slot_cap, dtype=np.uint32)
+    counts = np.zeros(nslots, dtype=np.uint32)
+    if _gear_pos2:
+        rc = lib.gear_scan_pos2(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.c_uint32(mask),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            slot_cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            nslots)
+    else:
+        rc = lib.gear_scan_pos(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.c_uint32(mask),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            slot_cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
     if rc != 0:
         bits = gear_scan_bits(buf, table, mask)
         return np.nonzero(bits)[0].astype(np.uint32)
     return np.concatenate([
-        out[s * stripe_cap:s * stripe_cap + int(counts[s])]
-        for s in range(4)])
+        out[s * slot_cap:s * slot_cap + int(counts[s])]
+        for s in range(nslots)])
 
 
 class LayerSinkHandle:

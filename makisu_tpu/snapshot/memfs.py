@@ -84,6 +84,7 @@ class MemFS:
         hdr.name = ""  # "/" itself never appears in layers
         self.tree = Node(root, "/", hdr)
         self.layers: list[Layer] = []
+        self._isa_logged = False  # route logged once per build (MemFS)
 
     # ------------------------------------------------------------------
     # Tree bookkeeping
@@ -180,6 +181,18 @@ class MemFS:
         # ordered producer the read-ahead / chunk-SHA / compress
         # stages overlap) — `makisu-tpu report` ranks the stages to
         # name the bottleneck.
+        if not self._isa_logged:
+            # One MemFS per build: the first layer commit names the
+            # resolved SIMD route in the build log (dispatch is chosen
+            # once per process in native.py). Throughput knob only —
+            # never part of cache identity. The flag burns only once a
+            # route exists, so a commit that lands before the native
+            # library loads doesn't swallow the line for the build.
+            from makisu_tpu import native
+            route = native.isa_route_if_resolved()
+            if route is not None:
+                self._isa_logged = True
+                log.info("layer-commit native ISA route: %s", route)
         t0 = time.monotonic()  # same clock as every other stage
         try:
             layer.commit(tw)

@@ -1,0 +1,51 @@
+// Internal seam between the portable gear/sha code (gear.cpp, built
+// with baseline flags) and the per-file-ISA translation units
+// (gear_simd.cpp: -mavx2, sha_ni.cpp: -msha -msse4.1). The SIMD TUs
+// always define every symbol below; on toolchains/targets without the
+// flags they compile to stubs whose *_compiled() probe returns 0, so
+// one portable build serves every host and the dispatcher in gear.cpp
+// simply never routes to a stub. Nothing here is part of the library
+// ABI — the extern "C" surface lives in gear.cpp.
+
+#ifndef MAKISU_NATIVE_GEAR_ISA_H_
+#define MAKISU_NATIVE_GEAR_ISA_H_
+
+#include <cstddef>
+#include <cstdint>
+
+namespace makisu_native {
+
+// ------------------------------------------------------------- gear/avx2
+// 8-lane (8 x u32 chains) gear scan. Bit-identical to the sequential
+// recurrence by construction: every position's hash depends on exactly
+// the 32 preceding bytes, so lane count is invisible in the output.
+int gear_avx2_compiled();
+
+// out[i] = 1 iff (h_i & mask) == 0, for i in [0, n).
+void gear_scan_avx2(const uint8_t* data, size_t n, const uint32_t* table,
+                    uint32_t mask, uint8_t* out);
+
+// Candidate positions, emitted into `nslots` ascending disjoint output
+// ranges (slot t owns stream range [n*t/nslots, n*(t+1)/nslots) and
+// appends into out_pos[t*slot_cap ..], counts[t] entries). Returns 0 on
+// success, 1 on slot overflow (caller falls back to the bit scan).
+int gear_scan_pos_avx2(const uint8_t* data, size_t n,
+                       const uint32_t* table, uint32_t mask,
+                       uint32_t* out_pos, size_t slot_cap,
+                       uint32_t* counts, size_t nslots);
+
+// ------------------------------------------------------------- sha/sha-ni
+int sha_ni_compiled();
+
+// Batch SHA-256 over `count` slices of one contiguous buffer via the
+// SHA-NI instruction set, scheduling up to kWays (3) independent
+// streams through one interleaved round loop (the rnds2 dependency
+// chain of a single stream leaves the unit half idle). Digests land at
+// out[32*i] and are byte-identical to any other SHA-256. Returns 0 on
+// success.
+int sha256_ni_batch(const uint8_t* data, const uint64_t* offsets,
+                    const uint64_t* lengths, size_t count, uint8_t* out);
+
+}  // namespace makisu_native
+
+#endif  // MAKISU_NATIVE_GEAR_ISA_H_

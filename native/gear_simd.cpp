@@ -1,0 +1,182 @@
+// AVX2 gear scan: the striped-recurrence trick of gear.cpp lifted to
+// 8 u32 lanes. Compiled with -mavx2 (per-file, see Makefile); on
+// targets/toolchains without AVX2 support this TU compiles to stubs
+// and gear_avx2_compiled() reports 0, so the portable build still
+// links and the dispatcher never routes here.
+//
+// The math is exactly gear.cpp's: h = (h << 1) + G[b] (mod 2^32), and
+// any position can be recomputed from a 32-byte warmup, so 8 lanes
+// each own stripe [n*s/8, n*(s+1)/8) and the concatenated output is
+// bit-identical to one sequential pass. Per step the kernel consumes
+// FOUR bytes per lane from one 32-bit data gather (one gather per 32
+// input bytes) and pays one table gather per 8 bytes — the table
+// lookup is the irreducible gather; amortizing the data load across 4
+// steps is what beats the 4-chain scalar interleave.
+
+#include "gear_isa.h"
+
+#if defined(__AVX2__)
+
+#include <immintrin.h>
+
+#include <cstring>
+
+namespace makisu_native {
+
+namespace {
+
+constexpr size_t kWindow = 32;  // bytes of history in a 32-bit h
+constexpr size_t kLanes = 8;
+
+inline uint32_t warm_hash(const uint8_t* data, size_t begin,
+                          const uint32_t* table) {
+  uint32_t h = 0;
+  size_t warm = begin >= kWindow ? begin - kWindow : 0;
+  for (size_t i = warm; i < begin; ++i) h = (h << 1) + table[data[i]];
+  return h;
+}
+
+// Shared stripe setup: bounds, warmed h vector, and the common vector
+// length (shortest stripe, rounded down to the 4-byte step).
+struct Stripes {
+  size_t bounds[kLanes + 1];
+  uint32_t h[kLanes];
+  size_t len;   // per-lane steps all lanes can take
+  size_t kvec;  // steps the vector loop takes (multiple of 4)
+};
+
+inline Stripes make_stripes(const uint8_t* data, size_t n,
+                            const uint32_t* table) {
+  Stripes st;
+  for (size_t s = 0; s <= kLanes; ++s) st.bounds[s] = n * s / kLanes;
+  st.len = n;
+  for (size_t s = 0; s < kLanes; ++s) {
+    st.h[s] = warm_hash(data, st.bounds[s], table);
+    size_t sl = st.bounds[s + 1] - st.bounds[s];
+    if (sl < st.len) st.len = sl;
+  }
+  st.kvec = st.len & ~size_t(3);
+  return st;
+}
+
+}  // namespace
+
+int gear_avx2_compiled() { return 1; }
+
+void gear_scan_avx2(const uint8_t* data, size_t n, const uint32_t* table,
+                    uint32_t mask, uint8_t* out) {
+  Stripes st = make_stripes(data, n, table);
+  const __m256i vmask = _mm256_set1_epi32(static_cast<int>(mask));
+  const __m256i zero = _mm256_setzero_si256();
+  const __m256i bytemask = _mm256_set1_epi32(0xFF);
+  const __m256i one = _mm256_set1_epi32(1);
+  __m256i base = _mm256_setr_epi32(
+      static_cast<int>(st.bounds[0]), static_cast<int>(st.bounds[1]),
+      static_cast<int>(st.bounds[2]), static_cast<int>(st.bounds[3]),
+      static_cast<int>(st.bounds[4]), static_cast<int>(st.bounds[5]),
+      static_cast<int>(st.bounds[6]), static_cast<int>(st.bounds[7]));
+  __m256i h = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(st.h));
+  for (size_t k = 0; k < st.kvec; k += 4) {
+    __m256i idx = _mm256_add_epi32(base,
+                                   _mm256_set1_epi32(static_cast<int>(k)));
+    __m256i w = _mm256_i32gather_epi32(
+        reinterpret_cast<const int*>(data), idx, 1);
+    __m256i acc = zero;  // 4 result bytes per lane, little-endian
+    for (int j = 0; j < 4; ++j) {
+      __m256i b = _mm256_and_si256(_mm256_srli_epi32(w, 8 * j), bytemask);
+      __m256i g = _mm256_i32gather_epi32(
+          reinterpret_cast<const int*>(table), b, 4);
+      h = _mm256_add_epi32(_mm256_slli_epi32(h, 1), g);
+      __m256i hit = _mm256_cmpeq_epi32(_mm256_and_si256(h, vmask), zero);
+      acc = _mm256_or_si256(acc, _mm256_slli_epi32(
+          _mm256_and_si256(hit, one), 8 * j));
+    }
+    alignas(32) uint32_t lane_out[kLanes];
+    _mm256_store_si256(reinterpret_cast<__m256i*>(lane_out), acc);
+    for (size_t s = 0; s < kLanes; ++s)
+      std::memcpy(out + st.bounds[s] + k, &lane_out[s], 4);
+  }
+  alignas(32) uint32_t hs[kLanes];
+  _mm256_store_si256(reinterpret_cast<__m256i*>(hs), h);
+  // Sub-step remainder plus uneven-division stripe tails, scalar.
+  for (size_t s = 0; s < kLanes; ++s) {
+    uint32_t hh = hs[s];
+    for (size_t i = st.bounds[s] + st.kvec; i < st.bounds[s + 1]; ++i) {
+      hh = (hh << 1) + table[data[i]];
+      out[i] = (hh & mask) == 0 ? 1 : 0;
+    }
+  }
+}
+
+int gear_scan_pos_avx2(const uint8_t* data, size_t n,
+                       const uint32_t* table, uint32_t mask,
+                       uint32_t* out_pos, size_t slot_cap,
+                       uint32_t* counts, size_t nslots) {
+  if (nslots != kLanes) return 1;  // dispatcher contract: 8 slots
+  Stripes st = make_stripes(data, n, table);
+  size_t cnt[kLanes] = {0};
+  const __m256i vmask = _mm256_set1_epi32(static_cast<int>(mask));
+  const __m256i zero = _mm256_setzero_si256();
+  const __m256i bytemask = _mm256_set1_epi32(0xFF);
+  __m256i base = _mm256_setr_epi32(
+      static_cast<int>(st.bounds[0]), static_cast<int>(st.bounds[1]),
+      static_cast<int>(st.bounds[2]), static_cast<int>(st.bounds[3]),
+      static_cast<int>(st.bounds[4]), static_cast<int>(st.bounds[5]),
+      static_cast<int>(st.bounds[6]), static_cast<int>(st.bounds[7]));
+  __m256i h = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(st.h));
+  for (size_t k = 0; k < st.kvec; k += 4) {
+    __m256i idx = _mm256_add_epi32(base,
+                                   _mm256_set1_epi32(static_cast<int>(k)));
+    __m256i w = _mm256_i32gather_epi32(
+        reinterpret_cast<const int*>(data), idx, 1);
+    for (int j = 0; j < 4; ++j) {
+      __m256i b = _mm256_and_si256(_mm256_srli_epi32(w, 8 * j), bytemask);
+      __m256i g = _mm256_i32gather_epi32(
+          reinterpret_cast<const int*>(table), b, 4);
+      h = _mm256_add_epi32(_mm256_slli_epi32(h, 1), g);
+      __m256i hit = _mm256_cmpeq_epi32(_mm256_and_si256(h, vmask), zero);
+      int m = _mm256_movemask_ps(_mm256_castsi256_ps(hit));
+      while (m) {  // ~1-in-mask per lane-step: predicts perfectly
+        int lane = __builtin_ctz(static_cast<unsigned>(m));
+        m &= m - 1;
+        if (cnt[lane] == slot_cap) return 1;
+        out_pos[lane * slot_cap + cnt[lane]++] =
+            static_cast<uint32_t>(st.bounds[lane] + k + j);
+      }
+    }
+  }
+  alignas(32) uint32_t hs[kLanes];
+  _mm256_store_si256(reinterpret_cast<__m256i*>(hs), h);
+  for (size_t s = 0; s < kLanes; ++s) {
+    uint32_t hh = hs[s];
+    for (size_t i = st.bounds[s] + st.kvec; i < st.bounds[s + 1]; ++i) {
+      hh = (hh << 1) + table[data[i]];
+      if ((hh & mask) == 0) {
+        if (cnt[s] == slot_cap) return 1;
+        out_pos[s * slot_cap + cnt[s]++] = static_cast<uint32_t>(i);
+      }
+    }
+    counts[s] = static_cast<uint32_t>(cnt[s]);
+  }
+  return 0;
+}
+
+}  // namespace makisu_native
+
+#else  // !__AVX2__: stubs so the portable build links everywhere.
+
+namespace makisu_native {
+
+int gear_avx2_compiled() { return 0; }
+
+void gear_scan_avx2(const uint8_t*, size_t, const uint32_t*, uint32_t,
+                    uint8_t*) {}
+
+int gear_scan_pos_avx2(const uint8_t*, size_t, const uint32_t*, uint32_t,
+                       uint32_t*, size_t, uint32_t*, size_t) {
+  return 1;
+}
+
+}  // namespace makisu_native
+
+#endif  // __AVX2__

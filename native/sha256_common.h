@@ -191,6 +191,31 @@ struct Digest256 {
   }
 };
 
+// Batch digest over slices of one contiguous buffer, EVP route: ONE
+// context hoisted across the whole batch (re-initialized per slice —
+// EVP_DigestInit_ex is the per-digest reset, ctx creation is the
+// overhead worth amortizing at ~8KiB slice sizes), and any slice whose
+// EVP calls fail degrades to the scalar implementation for THAT slice
+// only — a mid-batch hiccup must never fail the batch, because every
+// route produces the same bytes anyway.
+inline void sha256_batch_evp_or_scalar(const uint8_t* data,
+                                       const uint64_t* offsets,
+                                       const uint64_t* lengths,
+                                       size_t count, uint8_t* out) {
+  void* ctx = evp().ok ? evp().md_ctx_new() : nullptr;
+  for (size_t i = 0; i < count; ++i) {
+    unsigned int len = 32;
+    if (ctx && evp().init(ctx, evp().sha256(), nullptr) == 1 &&
+        evp().update(ctx, data + offsets[i], lengths[i]) == 1 &&
+        evp().final(ctx, out + 32 * i, &len) == 1)
+      continue;
+    Sha256 d;
+    d.update(data + offsets[i], lengths[i]);
+    d.final(out + 32 * i);
+  }
+  if (ctx) evp().md_ctx_free(ctx);
+}
+
 }  // namespace makisu_native
 
 #endif  // MAKISU_NATIVE_SHA256_COMMON_H_

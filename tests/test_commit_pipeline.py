@@ -159,6 +159,36 @@ def test_chunk_session_identity_across_workers():
 
 @pytest.mark.skipif(not native.sha_batch_available(),
                     reason="libgear.so sha batch not built")
+@pytest.mark.parametrize("level", ["scalar", "striped", "simd"])
+def test_chunk_session_identity_across_isa_levels(level):
+    """The MAKISU_TPU_NATIVE_ISA ladder is a throughput knob only:
+    every ISA level × worker count must reproduce the auto route's
+    exact chunk boundaries and digests (the byte-identity the CI
+    fastest-route step sweeps with the env knob)."""
+    if native.isa_route() is None:
+        pytest.skip("ISA dispatch ABI unavailable")
+    rng = np.random.default_rng(27)
+    payload = rng.integers(0, 256, size=2_000_000,
+                           dtype=np.uint8).tobytes()
+    try:
+        native.set_native_isa("auto")
+        s = ChunkSession(workers=1)
+        s.update(payload)
+        ref = [(c.offset, c.length, c.hex) for c in s.finish()]
+        assert ref
+        native.set_native_isa(level)
+        for workers in (1, 4):
+            s = ChunkSession(workers=workers)
+            for i in range(0, len(payload), 100_001):
+                s.update(payload[i:i + 100_001])
+            got = [(c.offset, c.length, c.hex) for c in s.finish()]
+            assert got == ref, (level, workers)
+    finally:
+        native.set_native_isa("auto")
+
+
+@pytest.mark.skipif(not native.sha_batch_available(),
+                    reason="libgear.so sha batch not built")
 def test_native_sha256_batch_matches_hashlib():
     rng = np.random.default_rng(3)
     datas = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()

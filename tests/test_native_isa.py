@@ -1,0 +1,148 @@
+"""Runtime ISA dispatch for the native hot path (gear + batch SHA).
+
+The invariant every route must satisfy: ISA is a THROUGHPUT knob, never
+an identity knob. SIMD gear cut positions and multi-buffer SHA digests
+must be bit-identical to the scalar reference (and, for SHA, to
+hashlib) on every buffer shape — sizes straddling the lane/stripe
+seams, empty and sub-window buffers, multi-MiB streams — and at every
+mask density. The property sweep here is the gate that lets the AVX2 /
+SHA-NI kernels ship inside the cache-identity-bearing pipeline.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from makisu_tpu import native
+from makisu_tpu.ops import gear
+
+pytestmark = pytest.mark.skipif(
+    not native.gear_scan_available() or native.isa_route() is None,
+    reason="libgear.so (or its ISA dispatch ABI) unavailable")
+
+# Sizes straddling every boundary the routes care about: empty,
+# sub-window, the 32-byte window, the striped threshold (4 chains x
+# 4 windows = 512), the SIMD threshold (8 lanes x 4 windows = 1024),
+# uneven lane/stripe seams, and multi-MiB with an odd tail.
+SIZES = (0, 1, 31, 32, 63, 64, 65, 511, 512, 513, 1023, 1024, 1025,
+         4096 + 7, 100_000, (1 << 20) + 17)
+
+GEAR_ROUTES = ("scalar", "striped", "avx2")
+SHA_ROUTES = ("scalar", "evp", "shani")
+
+
+@pytest.fixture(autouse=True)
+def _restore_auto():
+    yield
+    native.set_native_isa("auto")
+
+
+def _force_gear(route: str) -> bool:
+    lib = native._load_gear()
+    return lib.gear_set_gear_isa(route.encode()) == 0
+
+
+def _force_sha(route: str) -> bool:
+    lib = native._load_gear()
+    return lib.gear_set_sha_isa(route.encode()) == 0
+
+
+def test_gear_routes_bit_identical_across_shapes_and_masks():
+    rng = np.random.default_rng(31)
+    table = gear.gear_table()
+    for size in SIZES:
+        data = rng.integers(0, 256, size=size, dtype=np.uint8)
+        for avg_bits in (5, 9, gear.DEFAULT_AVG_BITS):
+            mask = (1 << avg_bits) - 1
+            ref_bits = ref_pos = None
+            for route in GEAR_ROUTES:
+                if not _force_gear(route):
+                    continue  # host can't run it (non-AVX2 box)
+                bits = native.gear_scan_bits(data, table, mask)
+                pos = native.gear_scan_positions(data, table, mask)
+                # Positions and bits must agree with each other...
+                assert np.array_equal(
+                    pos, np.nonzero(bits)[0].astype(np.uint32)), \
+                    (route, size, avg_bits)
+                if ref_bits is None:
+                    ref_bits, ref_pos = bits, pos  # scalar reference
+                # ...and with the scalar reference, bit for bit.
+                assert np.array_equal(bits, ref_bits), \
+                    (route, size, avg_bits)
+                assert np.array_equal(pos, ref_pos), \
+                    (route, size, avg_bits)
+
+
+def test_gear_scalar_matches_pure_python_recurrence():
+    """Anchor the whole ladder to first principles: the C scalar route
+    equals the h = (h << 1) + G[b] recurrence written in Python."""
+    rng = np.random.default_rng(32)
+    table = gear.gear_table()
+    mask = (1 << 9) - 1
+    data = rng.integers(0, 256, size=5_000, dtype=np.uint8)
+    assert _force_gear("scalar")
+    got = native.gear_scan_bits(data, table, mask)
+    h = 0
+    want = np.zeros(len(data), dtype=np.uint8)
+    for i, b in enumerate(data.tolist()):
+        h = ((h << 1) + int(table[b])) & 0xFFFFFFFF
+        want[i] = 1 if (h & mask) == 0 else 0
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.skipif(not native.sha_batch_available(),
+                    reason="gear_sha256_batch not built")
+def test_sha_routes_match_hashlib_across_slice_shapes():
+    """Every SHA route × slice-length shape (padding seams at 55/56/
+    63/64, multi-block, empty, multi-MiB) must equal hashlib — the
+    2-way/3-way SHA-NI scheduler retires and refills streams of
+    unequal lengths, so ragged batches are the adversarial shape."""
+    rng = np.random.default_rng(33)
+    fixed = [0, 1, 55, 56, 57, 63, 64, 65, 119, 127, 128, 129, 8191,
+             65_536, (1 << 20) + 3]
+    ragged = [int(x) for x in rng.integers(0, 10_000, size=40)]
+    for sizes in (fixed, ragged):
+        datas = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+                 for s in sizes]
+        buf = b"".join(datas)
+        want = [hashlib.sha256(d).digest() for d in datas]
+        for route in SHA_ROUTES:
+            if not _force_sha(route):
+                continue  # host can't run it (no SHA-NI / no OpenSSL)
+            digests = native.sha256_batch(buf, [len(d) for d in datas])
+            got = [row.tobytes() for row in digests]
+            assert got == want, route
+
+
+def test_isa_level_mapping_and_introspection():
+    route = native.set_native_isa("scalar")
+    assert route == "gear=scalar,sha=scalar"
+    route = native.set_native_isa("striped")
+    assert route.startswith("gear=striped,sha=")
+    if native.isa_supported("avx2") and native.isa_supported("shani"):
+        assert native.set_native_isa("simd") == "gear=avx2,sha=shani"
+    auto = native.set_native_isa("auto")
+    assert auto is not None and auto.startswith("gear=")
+    with pytest.raises(ValueError):
+        native.set_native_isa("pentium")
+    assert native.isa_supported("scalar")
+    assert not native.isa_supported("quantum")
+
+
+def test_env_knob_applies_at_load():
+    """MAKISU_TPU_NATIVE_ISA is read once when libgear loads; a child
+    process with the knob set must resolve the capped route."""
+    code = ("from makisu_tpu import native; "
+            "print(native.isa_route())")
+    env = dict(os.environ, MAKISU_TPU_NATIVE_ISA="scalar",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120, check=True)
+    # stdout also carries the load-time "route resolved" log line; the
+    # route print is last.
+    assert out.stdout.strip().splitlines()[-1] == "gear=scalar,sha=scalar"
