@@ -945,8 +945,15 @@ def _cache_explain_round() -> dict:
                                  dtype=np.uint8).tobytes())
         os.makedirs(os.path.join(tmp, "root"))
 
+        # Every round's builds append to the persistent build-history
+        # file (benchmarks/history/) — the cross-round perf
+        # trajectory `makisu-tpu history` renders and the BENCH
+        # record embeds a tail of (see _history_tail).
+        history_out = _bench_history_path()
+
         def build(led: str | None, rep: str | None) -> float:
-            argv = ["--log-level", "error"]
+            argv = ["--log-level", "error",
+                    "--history-out", history_out]
             if led:
                 argv += ["--explain-out", led]
             if rep:
@@ -962,9 +969,27 @@ def _cache_explain_round() -> dict:
             return time.perf_counter() - t0
 
         build(None, None)  # cold: populate layer cache + statcache
+        # Warm rebuilds repeat: one sample per round made r01–r05's
+        # warm figures best-of-one lottery tickets; p50/p99 over
+        # repeats is what the fleet-latency story quotes. The ledger/
+        # metrics artifacts come from the LAST repeat (all repeats are
+        # byte-identical warm builds of the same tree).
+        try:
+            repeats = max(1, int(os.environ.get(
+                "MAKISU_BENCH_WARM_REPEATS", "5") or 5))
+        except ValueError:
+            repeats = 5
         warm_led = os.path.join(out_dir, "warm_ledger.jsonl")
-        warm_s = build(warm_led, os.path.join(out_dir,
-                                              "warm_metrics.json"))
+        warm_times = []
+        for rep_i in range(repeats):
+            last = rep_i == repeats - 1
+            warm_times.append(build(
+                warm_led if last else None,
+                os.path.join(out_dir, "warm_metrics.json")
+                if last else None))
+        from makisu_tpu.utils import metrics as metrics_mod
+        warm_stats = metrics_mod.percentile_stats(warm_times)
+        warm_s = warm_stats["p50"]
         with open(os.path.join(ctx, "src", "mod3.py"), "a") as f:
             f.write("EDITED = True\n")
         edit_led = os.path.join(out_dir, "edited_ledger.jsonl")
@@ -983,6 +1008,9 @@ def _cache_explain_round() -> dict:
         summary = edited["summary"]
         return {
             "warm_seconds": round(warm_s, 3),
+            "warm_seconds_p50": round(warm_stats["p50"], 3),
+            "warm_seconds_p99": round(warm_stats["p99"], 3),
+            "warm_repeats": repeats,
             "edited_seconds": round(edit_s, 3),
             "warm_all_hit": all(
                 d["verdict"] == "hit"
@@ -1003,6 +1031,38 @@ def _cache_explain_round() -> dict:
         else:
             os.environ["MAKISU_TPU_STAT_CACHE_WINDOW_NS"] = old_window
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_history_path() -> str:
+    path = os.path.join(_REPO, "benchmarks", "history",
+                        "history.jsonl")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _history_tail(limit: int = 8) -> dict:
+    """The build-history trajectory's tail for the BENCH record: how
+    this round's builds sit against previous rounds' without digging
+    up old BENCH files. Compact: per-record duration/cache digest
+    only (the full records stay in benchmarks/history/)."""
+    from makisu_tpu.utils import history as history_mod
+    path = _bench_history_path()
+    records = history_mod.read_history(path) \
+        if os.path.exists(path) else []
+    return {
+        "path": os.path.relpath(path, _REPO),
+        "records": len(records),
+        "aggregate": history_mod.aggregate(records),
+        "tail": [{
+            "ts": r.get("ts"),
+            "command": r.get("command"),
+            "duration_seconds": r.get("duration_seconds"),
+            "cache_hit_ratio": r.get("cache", {}).get("hit_ratio"),
+            "chunk_dedup_ratio": r.get("cache", {}).get(
+                "chunk_dedup_ratio"),
+            "exit_code": r.get("exit_code"),
+        } for r in records[-limit:]],
+    }
 
 
 def main() -> int:
@@ -1174,6 +1234,13 @@ def main() -> int:
         record["cache_explain"] = _cache_explain_round()
     except Exception as e:  # noqa: BLE001 - informational section
         record["cache_explain"] = {"error": str(e)[:200]}
+    # Build-history tail: the persistent perf trajectory
+    # (benchmarks/history/) this round just extended — `makisu-tpu
+    # history diff` between two rounds' files is the regression gate.
+    try:
+        record["history"] = _history_tail()
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["history"] = {"error": str(e)[:200]}
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))

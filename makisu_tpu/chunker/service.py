@@ -32,6 +32,13 @@ from makisu_tpu.utils import metrics
 _FILL_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0)
 
+# Occupancy histogram buckets: lanes filled ÷ lane capacity per
+# dispatched program. The fleet-batching signal (ROADMAP item 1): a
+# worker whose occupancy sits near 1.0 is amortizing device programs
+# across builds; near 1/lanes it is dispatching half-empty batches and
+# more concurrency (or a longer linger) would pay.
+_OCCUPANCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
 class HashService:
     """Cross-build chunk-hash batcher. Thread-safe; one per process."""
 
@@ -136,6 +143,9 @@ class HashService:
                         time.monotonic() - t0, bucket=cap)
         metrics.observe("makisu_hash_batch_fill", len(batch),
                         buckets=_FILL_BUCKETS, bucket=cap)
+        metrics.observe("makisu_hash_batch_occupancy",
+                        len(batch) / lanes,
+                        buckets=_OCCUPANCY_BUCKETS, bucket=cap)
         for i, (_, fut, _) in enumerate(batch):
             fut.set_result(words[i].astype(">u4").tobytes())
 

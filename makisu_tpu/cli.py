@@ -78,6 +78,15 @@ def make_parser() -> argparse.ArgumentParser:
                              "line per cache consult with verdict/reason/"
                              "blame, plus a summary line) to FILE — the "
                              "input `makisu-tpu explain` renders")
+    parser.add_argument("--history-out", default="", metavar="FILE",
+                        help="append one compact build-history record "
+                             "(JSONL, schema makisu-tpu.history.v1: "
+                             "duration, phase self-times, cache "
+                             "economics, ISA route) to FILE after "
+                             "build/pull/push commands; without it, "
+                             "records land in $MAKISU_TPU_HISTORY_DIR/"
+                             "history.jsonl when set — the input "
+                             "`makisu-tpu history` renders")
     parser.add_argument("--diag-out", default="", metavar="FILE",
                         help="write a JSON diagnostic bundle (flight-"
                              "recorder ring, open spans, thread stacks, "
@@ -192,6 +201,96 @@ def make_parser() -> argparse.ArgumentParser:
     worker = sub.add_parser("worker", help="run a long-lived build worker")
     worker.add_argument("--socket", default="/tmp/makisu-tpu-worker.sock",
                         help="unix socket to listen on")
+    worker.add_argument("--max-concurrent-builds", type=int, default=0,
+                        metavar="N",
+                        help="cap concurrently executing builds; "
+                             "arrivals beyond the cap wait in a FIFO "
+                             "admission queue (instrumented: "
+                             "makisu_worker_queue_depth, queue-wait/"
+                             "latency histograms, GET /builds). "
+                             "0 = unlimited (default; env "
+                             "MAKISU_TPU_MAX_CONCURRENT_BUILDS)")
+
+    top = sub.add_parser(
+        "top", help="live terminal view of a worker's builds")
+    top.add_argument("--socket", default="/tmp/makisu-tpu-worker.sock",
+                     help="worker unix socket to poll")
+    top.add_argument("--interval", type=float, default=2.0,
+                     metavar="SECONDS", help="refresh interval")
+    top.add_argument("--once", action="store_true",
+                     help="print a single frame and exit (no screen "
+                          "clearing; for scripts)")
+    top.add_argument("--count", type=int, default=0, metavar="N",
+                     help="exit after N frames (0 = until interrupted)")
+
+    loadgen = sub.add_parser(
+        "loadgen", help="synthetic concurrent-build load harness "
+                        "against a real worker")
+    loadgen.add_argument("--socket", default="",
+                         help="drive this live worker (default: spawn "
+                              "an in-process worker for the run)")
+    loadgen.add_argument("--concurrency", type=int, default=4,
+                         metavar="N",
+                         help="concurrent submission lanes")
+    loadgen.add_argument("--builds", type=int, default=0, metavar="M",
+                         help="total builds to run (default "
+                              "2 x concurrency)")
+    loadgen.add_argument("--contexts", type=int, default=0,
+                         metavar="K",
+                         help="distinct generated context templates "
+                              "(default = concurrency, capped at it)")
+    loadgen.add_argument("--files", type=int, default=16,
+                         help="files per generated context")
+    loadgen.add_argument("--file-kb", type=int, default=4,
+                         help="KiB per generated file")
+    loadgen.add_argument("--edit-churn", type=float, default=0.25,
+                         metavar="FRACTION",
+                         help="fraction of a lane's files append-"
+                              "edited before each rebuild")
+    loadgen.add_argument("--tenants", default="tenant-a,tenant-b",
+                         help="comma-separated tenant mix, assigned "
+                              "to lanes round-robin")
+    loadgen.add_argument("--hasher", default="tpu",
+                         choices=["cpu", "tpu"],
+                         help="hashing backend for the synthetic "
+                              "builds (tpu exercises chunk dedup + "
+                              "the shared hash service)")
+    loadgen.add_argument("--max-concurrent-builds", type=int,
+                         default=0, metavar="N",
+                         help="admission cap for the SPAWNED worker "
+                              "(ignored with --socket)")
+    loadgen.add_argument("--report", default="", metavar="FILE",
+                         help="write the structured JSON report "
+                              "(schema makisu-tpu.loadgen.v1) here")
+    loadgen.add_argument("--work-dir", default="",
+                         help="working directory for contexts/storage "
+                              "(default: a tempdir, removed after)")
+    loadgen.add_argument("--poll-interval", type=float, default=0.5,
+                         metavar="SECONDS",
+                         help="/healthz + /builds sampling interval")
+    loadgen.add_argument("--ready-timeout", type=float, default=30.0,
+                         metavar="SECONDS",
+                         help="how long to wait for the worker's "
+                              "/ready")
+
+    history = sub.add_parser(
+        "history", help="render build-history trends, or `history "
+                        "diff A B` to gate on regressions")
+    history.add_argument("history_args", nargs="+",
+                         metavar="PATH | diff A B",
+                         help="history JSONL file(s) or directory "
+                              "(rendered as a trend); or `diff A B` "
+                              "to compare candidate B against "
+                              "baseline A (exit 1 on a flagged "
+                              "regression)")
+    history.add_argument("--threshold", type=float, default=0.25,
+                         metavar="FRACTION",
+                         help="diff regression threshold: flag p50/"
+                              "p99 latency growth or hit/dedup-ratio "
+                              "drops beyond this fraction "
+                              "(default 0.25)")
+    history.add_argument("--limit", type=int, default=20,
+                         help="records shown in the trend view")
 
     report = sub.add_parser(
         "report", help="critical-path analysis of a telemetry report")
@@ -656,7 +755,9 @@ def cmd_worker(args) -> int:
     server = WorkerServer(args.socket,
                           stall_window=(args.stall_timeout or
                                         None),
-                          diag_out=args.diag_out)
+                          diag_out=args.diag_out,
+                          max_concurrent_builds=
+                          args.max_concurrent_builds)
     # Process-level signal forensics: a worker killed by its
     # supervisor (SIGTERM) or poked for live inspection (SIGUSR1)
     # dumps a bundle covering EVERY in-flight build — the server's
@@ -674,6 +775,59 @@ def cmd_worker(args) -> int:
         pass
     finally:
         server.server_close()
+    return 0
+
+
+def cmd_top(args) -> int:
+    """Live terminal view of a worker: in-flight builds (tenant,
+    phase, progress age, queue wait, cache hit rate), the admission
+    queue, and the transfer plane — polled from ``/builds`` +
+    ``/healthz``."""
+    from makisu_tpu.tools import top
+    return top.run(args)
+
+
+def cmd_loadgen(args) -> int:
+    """Synthetic concurrent-build load harness: N lanes of generated-
+    context builds against a real worker, reporting p50/p99 latency,
+    the queue-wait split, per-tenant fairness, hash-batch occupancy,
+    and the cache hit-rate trajectory."""
+    from makisu_tpu.tools import loadgen
+    return loadgen.run(args)
+
+
+def cmd_history(args) -> int:
+    """Render build-history trends, or gate on a regression:
+    ``history PATH...`` renders the trend view; ``history diff A B``
+    compares candidate B against baseline A. Exit codes are gate-
+    script friendly: 0 = ok, 1 = a latency/cache regression beyond
+    ``--threshold`` was flagged, 2 = unreadable input (a missing
+    baseline must not look like a regression)."""
+    from makisu_tpu.utils import history as history_mod
+    tokens = args.history_args
+
+    def read(path: str) -> list[dict]:
+        try:
+            return history_mod.read_history(path)
+        except OSError as e:
+            log.error("cannot read history %s: %s", path, e)
+            raise SystemExit(2)
+
+    if tokens[0] == "diff":
+        if len(tokens) != 3:
+            raise SystemExit(
+                "history diff takes exactly two history paths: "
+                "`makisu-tpu history diff BASELINE CANDIDATE`")
+        result = history_mod.diff(read(tokens[1]), read(tokens[2]),
+                                  threshold=args.threshold)
+        print(history_mod.render_diff(result), end="")
+        return 0 if result["ok"] else 1
+    records: list[dict] = []
+    for path in tokens:
+        records.extend(read(path))
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    print(history_mod.render_trends(records, limit=args.limit),
+          end="")
     return 0
 
 
@@ -702,7 +856,8 @@ def main(argv: list[str] | None = None) -> int:
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
                 "diff": cmd_diff, "worker": cmd_worker,
                 "report": cmd_report, "doctor": cmd_doctor,
-                "explain": cmd_explain}
+                "explain": cmd_explain, "top": cmd_top,
+                "loadgen": cmd_loadgen, "history": cmd_history}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -898,12 +1053,34 @@ def main(argv: list[str] | None = None) -> int:
             # breakdown lives in --metrics-out / the worker's /metrics.
             log.info("build telemetry", exit_code=code,
                      **metrics.summary(registry))
-        if args.metrics_out or args.trace_out:
-            # One registry.report() feeds both files — the span tree
+        # Build-history record: one compact JSONL line per real-work
+        # invocation, appended to --history-out (or
+        # $MAKISU_TPU_HISTORY_DIR/history.jsonl) — the durable perf
+        # trajectory `makisu-tpu history` renders and `history diff`
+        # gates on. Only real-work commands record: a `report` or
+        # `history` invocation has no build trajectory to extend.
+        history_path = ""
+        if args.command in ("build", "pull", "push"):
+            from makisu_tpu.utils import history as history_mod
+            history_path = history_mod.resolve_out(args.history_out)
+        if args.metrics_out or args.trace_out or history_path:
+            # One registry.report() feeds every output — the span tree
             # and counter tables are not walked twice per build.
             report = registry.report()
             report["command"] = args.command or ""
             report["exit_code"] = code
+            if history_path:
+                try:
+                    history_mod.append_record(
+                        history_path,
+                        history_mod.record_from_report(
+                            report, command=args.command or "",
+                            exit_code=code))
+                    log.info("history record appended to %s",
+                             history_path)
+                except OSError as e:
+                    log.error("failed to append history record: %s",
+                              e)
             if args.metrics_out:
                 try:
                     metrics.write_json_atomic(args.metrics_out, report)

@@ -1,0 +1,411 @@
+"""``makisu-tpu loadgen``: synthetic concurrent-build load harness.
+
+ROADMAP item 1's build-farm scheduler needs numbers nobody has yet:
+what queue wait, per-tenant latency, and hash-batch occupancy look
+like when N builds hit one worker at once. This harness produces them
+against a REAL worker — either a live one (``--socket``) or an
+in-process one it spawns for the run — with M generated contexts,
+configurable edit churn between rebuilds, and a tenant mix.
+
+Shape of a run:
+
+- ``--contexts K`` template trees are generated (``--files`` files of
+  ``--file-kb`` KiB each); each of the ``--concurrency N`` lanes
+  copies one template into a private context + storage, so repeated
+  builds on a lane hit a warm cache while lanes stay fully parallel.
+- Lanes submit builds round-robin until ``--builds M`` complete; each
+  rebuild first edits ``--edit-churn`` of the lane's files (append —
+  the incremental-rebuild workload). Lane i carries tenant
+  ``tenants[i % len]`` via the ``X-Makisu-Tenant`` header.
+- A sampler thread polls ``/healthz`` + ``/builds`` through the run:
+  the cache hit-rate trajectory, queue depth, and the in-flight peak
+  all land in the report.
+
+The structured report (``--report FILE``, schema
+``makisu-tpu.loadgen.v1``) carries p50/p99 build latency, the
+queue-wait vs execution split, per-tenant latency digests and the
+fairness ratio (max tenant p99 ÷ min tenant p99), HashService batch
+occupancy scraped from ``/metrics``, and the trajectory. Exit code is
+nonzero when any build failed.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+import threading
+import time
+
+from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
+
+LOADGEN_SCHEMA = "makisu-tpu.loadgen.v1"
+
+_OCCUPANCY_RE = re.compile(
+    r'^makisu_hash_batch_occupancy_(sum|count)\{[^}]*\}\s+(\S+)$',
+    re.MULTILINE)
+
+
+def _make_template(root: str, index: int, files: int,
+                   file_kb: int) -> None:
+    """One template context: a src/ tree + Dockerfile. Content is
+    seeded per (template, file) so distinct templates chunk-dedup
+    against each other realistically (shared boilerplate, distinct
+    payload)."""
+    src = os.path.join(root, "src")
+    # exist_ok + overwrite throughout: re-running with the same
+    # --work-dir regenerates templates in place instead of crashing
+    # on the previous run's trees.
+    os.makedirs(src, exist_ok=True)
+    for i in range(files):
+        body = [f"# template {index} module {i}\n"]
+        line = f"payload_{index}_{i} = {i}\n"
+        while sum(len(s) for s in body) < file_kb * 1024:
+            body.append(line * 16)
+        with open(os.path.join(src, f"mod{i}.py"), "w") as f:
+            f.write("".join(body))
+    # A stable base/ layer edits never touch: warm rebuilds HIT its
+    # cache node while the churned src/ node misses — so the hit-rate
+    # trajectory and the miss attribution both have signal.
+    base = os.path.join(root, "base")
+    os.makedirs(base, exist_ok=True)
+    with open(os.path.join(base, "vendor.txt"), "w") as f:
+        f.write(f"# template {index} vendored base\n" * 64)
+    with open(os.path.join(root, "Dockerfile"), "w") as f:
+        f.write("FROM scratch\nCOPY base/ /base/\nCOPY src/ /src/\n")
+
+
+def _edit_files(ctx: str, churn: float, stamp: str) -> int:
+    """Append-edit ``churn`` of the context's files (at least one when
+    churn > 0) — the between-builds developer edit loadgen models."""
+    src = os.path.join(ctx, "src")
+    names = sorted(os.listdir(src))
+    if not names or churn <= 0:
+        return 0
+    n_edit = max(1, int(len(names) * churn))
+    for name in names[:n_edit]:
+        with open(os.path.join(src, name), "a") as f:
+            f.write(f"# edited {stamp}\n")
+    return n_edit
+
+
+def _occupancy_from_metrics(text: str) -> dict | None:
+    """Average lane occupancy (lanes filled ÷ lane capacity) from the
+    worker's Prometheus text — the fleet-batching signal. ``None``
+    when the hash service dispatched no batches this run (e.g. the
+    native CPU route bypassed it)."""
+    total = count = 0.0
+    for kind, value in _OCCUPANCY_RE.findall(text):
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if kind == "sum":
+            total += v
+        else:
+            count += v
+    if not count:
+        return None
+    return {"batches": int(count),
+            "mean_occupancy": round(total / count, 4)}
+
+
+class _Sampler(threading.Thread):
+    """Polls /healthz + /builds through the run: the cache hit-rate
+    trajectory and the in-flight/queue peaks."""
+
+    def __init__(self, client, interval: float) -> None:
+        super().__init__(daemon=True, name="loadgen-sampler")
+        self.client = client
+        self.interval = interval
+        self.samples: list[dict] = []
+        self.peak_inflight = 0
+        self.peak_queue_depth = 0
+        self.saw_running_build = False
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        t0 = time.monotonic()
+        while not self._halt.is_set():
+            try:
+                health = self.client.healthz()
+                builds = self.client.builds()
+            except (OSError, RuntimeError, ValueError):
+                self._halt.wait(self.interval)
+                continue
+            cache = health.get("cache", {})
+            hits = cache.get("hits", 0)
+            misses = cache.get("misses", 0)
+            inflight = builds.inflight
+            self.peak_inflight = max(self.peak_inflight, len(inflight))
+            self.peak_queue_depth = max(self.peak_queue_depth,
+                                        builds.queue_depth)
+            if any(b.state == "running" for b in inflight):
+                self.saw_running_build = True
+            self.samples.append({
+                "t": round(time.monotonic() - t0, 3),
+                "active_builds": health.active_builds,
+                "queue_depth": builds.queue_depth,
+                "cache_hits": hits,
+                "cache_misses": misses,
+                "cache_hit_ratio": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0,
+                "chunk_dedup_ratio": cache.get("chunk_dedup_ratio",
+                                               0.0),
+            })
+            self._halt.wait(self.interval)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5)
+
+
+def run(args) -> int:
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    concurrency = max(1, args.concurrency)
+    total_builds = args.builds if args.builds > 0 else 2 * concurrency
+    n_contexts = max(1, min(args.contexts or concurrency,
+                            concurrency))
+    tenants = [t for t in (args.tenants or "").split(",") if t] \
+        or ["default"]
+
+    work_dir = args.work_dir or tempfile.mkdtemp(
+        prefix="makisu-loadgen-")
+    os.makedirs(work_dir, exist_ok=True)
+    cleanup_work = not args.work_dir
+
+    server = None
+    sampler = None
+    metrics_text = ""
+    final_health: dict = {}
+    wall = 0.0
+    socket_path = args.socket
+    templates: list[str] = []
+
+    results: list[dict] = []
+    results_mu = threading.Lock()
+    next_seq = [0]
+
+    def lane(i: int) -> None:
+        client = WorkerClient(socket_path)
+        tenant = tenants[i % len(tenants)]
+        ctx = os.path.join(work_dir, f"lane{i}", "ctx")
+        os.makedirs(os.path.dirname(ctx), exist_ok=True)
+        shutil.copytree(templates[i % n_contexts], ctx,
+                        dirs_exist_ok=True)
+        storage = os.path.join(work_dir, f"lane{i}", "storage")
+        root = os.path.join(work_dir, f"lane{i}", "root")
+        os.makedirs(root, exist_ok=True)
+        lane_build = 0
+        while True:
+            with results_mu:
+                seq = next_seq[0]
+                if seq >= total_builds:
+                    return
+                next_seq[0] += 1
+            if lane_build > 0:
+                _edit_files(ctx, args.edit_churn, f"b{seq}")
+            argv = ["--log-level", "error",
+                    "build", ctx, "-t", f"loadgen/lane{i}:b{seq}",
+                    "--storage", storage, "--root", root,
+                    "--hasher", args.hasher]
+            if args.history_out:
+                argv = ["--history-out", args.history_out] + argv
+            t0 = time.monotonic()
+            try:
+                code = client.build(argv, tenant=tenant)
+            except (OSError, RuntimeError) as e:
+                code = -1
+                log.error("loadgen lane %d build %d failed to "
+                          "submit: %s", i, seq, e)
+            elapsed = time.monotonic() - t0
+            terminal = client.last_build or {}
+            queue_wait = float(terminal.get("queue_wait_seconds",
+                                            0.0))
+            with results_mu:
+                results.append({
+                    "seq": seq,
+                    "lane": i,
+                    "tenant": tenant,
+                    "exit_code": code,
+                    "latency_seconds": round(elapsed, 3),
+                    "queue_wait_seconds": round(queue_wait, 3),
+                    "exec_seconds": round(
+                        max(elapsed - queue_wait, 0.0), 3),
+                    "warm": lane_build > 0,
+                })
+            lane_build += 1
+
+    # Everything past this point — including worker spawn and template
+    # generation — runs under one finally, so an error (or the worker
+    # never answering /ready) can't leak the spawned server, its
+    # socket, or a mkdtemp work directory.
+    try:
+        if not socket_path:
+            socket_path = os.path.join(work_dir,
+                                       "loadgen-worker.sock")
+            server = WorkerServer(
+                socket_path,
+                max_concurrent_builds=args.max_concurrent_builds)
+            server.serve_background()
+            log.info("loadgen spawned in-process worker on %s "
+                     "(max_concurrent_builds=%d)", socket_path,
+                     server.max_concurrent_builds)
+
+        for k in range(n_contexts):
+            template = os.path.join(work_dir, f"template{k}")
+            _make_template(template, k, args.files, args.file_kb)
+            templates.append(template)
+
+        client = WorkerClient(socket_path)
+        deadline = time.monotonic() + args.ready_timeout
+        while not client.ready():
+            if time.monotonic() >= deadline:
+                log.error("worker on %s never became ready",
+                          socket_path)
+                return 1
+            time.sleep(0.1)
+
+        sampler = _Sampler(client, args.poll_interval)
+        sampler.start()
+        t_run = time.monotonic()
+        lanes = [threading.Thread(target=lane, args=(i,),
+                                  name=f"loadgen-lane-{i}")
+                 for i in range(concurrency)]
+        for t in lanes:
+            t.start()
+        for t in lanes:
+            t.join()
+        wall = time.monotonic() - t_run
+        try:
+            metrics_text = client.metrics()
+            final_health = dict(client.healthz())
+        except (OSError, RuntimeError):
+            pass
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if cleanup_work:
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+    report = _build_report(args, results, sampler, metrics_text,
+                           final_health, wall, tenants)
+    if args.report:
+        metrics.write_json_atomic(args.report, report)
+        log.info("loadgen report written to %s", args.report)
+    print(render_report(report), end="")
+    return 0 if report["failures"] == 0 and results else 1
+
+
+def _build_report(args, results, sampler, metrics_text, final_health,
+                  wall, tenants) -> dict:
+    ok = [r for r in results if r["exit_code"] == 0]
+    latencies = [r["latency_seconds"] for r in ok]
+    waits = [r["queue_wait_seconds"] for r in ok]
+    execs = [r["exec_seconds"] for r in ok]
+    per_tenant = {}
+    for tenant in tenants:
+        mine = [r["latency_seconds"] for r in ok
+                if r["tenant"] == tenant]
+        per_tenant[tenant] = metrics.percentile_stats(mine)
+    p99s = [stats["p99"] for stats in per_tenant.values()
+            if stats["count"]]
+    fairness = (round(max(p99s) / min(p99s), 3)
+                if len(p99s) > 1 and min(p99s) > 0 else 1.0)
+    warm = [r["latency_seconds"] for r in ok if r["warm"]]
+    cold = [r["latency_seconds"] for r in ok if not r["warm"]]
+    total_wait = sum(waits)
+    total_latency = sum(latencies)
+    return {
+        "schema": LOADGEN_SCHEMA,
+        "config": {
+            "concurrency": args.concurrency,
+            "builds": len(results),
+            "contexts": args.contexts or args.concurrency,
+            "files": args.files,
+            "file_kb": args.file_kb,
+            "edit_churn": args.edit_churn,
+            "tenants": tenants,
+            "hasher": args.hasher,
+            "max_concurrent_builds": args.max_concurrent_builds,
+        },
+        "wall_seconds": round(wall, 3),
+        "builds": len(results),
+        "failures": sum(1 for r in results if r["exit_code"] != 0),
+        "throughput_builds_per_s": round(len(results) / wall, 3)
+        if wall else 0.0,
+        "latency_seconds": metrics.percentile_stats(latencies),
+        "queue_wait_seconds": metrics.percentile_stats(waits),
+        "exec_seconds": metrics.percentile_stats(execs),
+        # What fraction of total build latency was spent waiting for
+        # admission — the saturation signal.
+        "queue_wait_share": round(total_wait / total_latency, 4)
+        if total_latency else 0.0,
+        "cold_latency_seconds": metrics.percentile_stats(cold),
+        "warm_latency_seconds": metrics.percentile_stats(warm),
+        "tenant_latency_seconds": per_tenant,
+        "tenant_fairness_p99_ratio": fairness,
+        "hash_batch_occupancy":
+            _occupancy_from_metrics(metrics_text),
+        "peak_inflight": sampler.peak_inflight,
+        "peak_queue_depth": sampler.peak_queue_depth,
+        "saw_running_build": sampler.saw_running_build,
+        "cache_trajectory": sampler.samples,
+        "worker_health": final_health,
+        "results": results,
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human digest of a loadgen report (the JSON carries the rest)."""
+    lat = report["latency_seconds"]
+    wait = report["queue_wait_seconds"]
+    execs = report["exec_seconds"]
+    lines = [
+        f"loadgen: {report['builds']} builds "
+        f"({report['failures']} failed) in "
+        f"{report['wall_seconds']:.1f}s — "
+        f"{report['throughput_builds_per_s']:.2f} builds/s",
+        f"  latency    p50 {lat.get('p50', 0.0):7.3f}s  "
+        f"p99 {lat.get('p99', 0.0):7.3f}s",
+        f"  queue wait p50 {wait.get('p50', 0.0):7.3f}s  "
+        f"p99 {wait.get('p99', 0.0):7.3f}s  "
+        f"(share {100.0 * report['queue_wait_share']:.1f}%)",
+        f"  execution  p50 {execs.get('p50', 0.0):7.3f}s  "
+        f"p99 {execs.get('p99', 0.0):7.3f}s",
+    ]
+    warm = report["warm_latency_seconds"]
+    cold = report["cold_latency_seconds"]
+    if warm.get("count") and cold.get("count"):
+        lines.append(
+            f"  cold p50 {cold['p50']:.3f}s → warm p50 "
+            f"{warm['p50']:.3f}s")
+    for tenant, stats in sorted(
+            report["tenant_latency_seconds"].items()):
+        if stats.get("count"):
+            lines.append(
+                f"  tenant {tenant:<12s} p50 {stats['p50']:7.3f}s  "
+                f"p99 {stats['p99']:7.3f}s  ({stats['count']} builds)")
+    lines.append(f"  fairness (max/min tenant p99): "
+                 f"{report['tenant_fairness_p99_ratio']:.2f}")
+    occ = report["hash_batch_occupancy"]
+    if occ:
+        lines.append(f"  hash batch occupancy: "
+                     f"{100.0 * occ['mean_occupancy']:.1f}% over "
+                     f"{occ['batches']} batches")
+    traj = report["cache_trajectory"]
+    if traj:
+        lines.append(
+            f"  cache hit-rate trajectory: "
+            f"{100.0 * traj[0]['cache_hit_ratio']:.0f}% → "
+            f"{100.0 * traj[-1]['cache_hit_ratio']:.0f}% over "
+            f"{len(traj)} samples")
+    lines.append(f"  peak in-flight {report['peak_inflight']}, "
+                 f"peak queue depth {report['peak_queue_depth']}")
+    return "\n".join(lines) + "\n"

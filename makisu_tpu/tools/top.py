@@ -1,0 +1,118 @@
+"""``makisu-tpu top``: live terminal view of a worker's builds.
+
+Polls ``GET /builds`` + ``GET /healthz`` over the worker socket and
+renders the operator's view of the (future) fleet node: in-flight
+builds with phase, progress-clock age, queue wait, and cache hit
+rate; the admission queue's depth and latency digests; the transfer
+plane's in-flight bytes. ``--once`` prints a single frame (scripts,
+tests); otherwise the screen refreshes every ``--interval`` seconds
+until interrupted.
+"""
+
+from __future__ import annotations
+
+import time
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 60:
+        return f"{seconds:.1f}s"
+    if seconds < 3600:
+        return f"{int(seconds // 60)}m{int(seconds % 60):02d}s"
+    return f"{int(seconds // 3600)}h{int(seconds % 3600 // 60):02d}m"
+
+
+def _trunc(text: str, width: int) -> str:
+    return text if len(text) <= width else text[:width - 1] + "…"
+
+
+def render_top(health: dict, builds: dict, socket_path: str) -> str:
+    """One frame. Pure function of the two payloads, so tests (and
+    any other consumer) can render canned snapshots."""
+    from makisu_tpu.utils.traceexport import fmt_bytes
+    queue = health.get("queue", {})
+    wait = queue.get("wait_seconds", {})
+    latency = queue.get("latency_seconds", {})
+    cap = queue.get("max_concurrent_builds", 0)
+    lines = [
+        f"makisu-tpu top — {socket_path}   "
+        f"uptime {_fmt_age(health.get('uptime_seconds', 0.0))}   "
+        f"active {health.get('active_builds', 0)}   "
+        f"queued {builds.get('queue_depth', 0)}"
+        + (f"/cap {cap}" if cap else " (no cap)"),
+        f"builds ok/fail {health.get('builds_succeeded', 0)}"
+        f"/{health.get('builds_failed', 0)}   "
+        f"queue wait p50/p99 {wait.get('p50', 0.0):.2f}s/"
+        f"{wait.get('p99', 0.0):.2f}s   "
+        f"latency p50/p99 {latency.get('p50', 0.0):.2f}s/"
+        f"{latency.get('p99', 0.0):.2f}s",
+        f"transfer in-flight "
+        f"{fmt_bytes(health.get('transfer_inflight_bytes', 0))}   "
+        f"last progress "
+        f"{health.get('last_progress_seconds', 0.0):.1f}s ago",
+        "",
+        f"{'ID':>4s} {'TENANT':<12s} {'STATE':<8s} {'PHASE':<6s} "
+        f"{'QWAIT':>7s} {'AGE':>7s} {'PROG':>6s} {'CACHE':>6s}  TAG",
+    ]
+    rows = list(builds.get("inflight", []))
+    for b in rows:
+        cache = b.get("cache", {})
+        consults = cache.get("kv_consults", 0)
+        cache_part = (f"{100.0 * cache.get('kv_hit_ratio', 0.0):.0f}%"
+                      if consults else "-")
+        lines.append(
+            f"{b.get('id', 0):>4d} "
+            f"{_trunc(b.get('tenant') or '-', 12):<12s} "
+            f"{b.get('state', '?'):<8s} "
+            f"{b.get('phase') or '-':<6s} "
+            f"{b.get('queue_wait_seconds', 0.0):>6.2f}s "
+            f"{_fmt_age(b.get('age_seconds', 0.0)):>7s} "
+            f"{_fmt_age(b.get('progress_age_seconds', 0.0)):>6s} "
+            f"{cache_part:>6s}  "
+            f"{_trunc(b.get('tag') or b.get('command', ''), 28)}")
+    if not rows:
+        lines.append("  (no builds in flight)")
+    recent = list(builds.get("recent", []))[:8]
+    if recent:
+        lines.append("")
+        lines.append("recent:")
+        for b in recent:
+            code = b.get("exit_code")
+            outcome = ("ok" if code == 0
+                       else f"exit {code}" if code is not None else "?")
+            lines.append(
+                f"{b.get('id', 0):>4d} "
+                f"{_trunc(b.get('tenant') or '-', 12):<12s} "
+                f"{outcome:<8s} "
+                f"wait {b.get('queue_wait_seconds', 0.0):.2f}s  "
+                f"ran {b.get('elapsed_seconds', 0.0):.2f}s  "
+                f"{_trunc(b.get('tag') or b.get('command', ''), 28)}")
+    return "\n".join(lines) + "\n"
+
+
+def run(args) -> int:
+    from makisu_tpu.worker import WorkerClient
+    client = WorkerClient(args.socket)
+    frames = 1 if args.once else args.count
+    shown = 0
+    while True:
+        try:
+            health = client.healthz()
+            builds = client.builds()
+        except (OSError, RuntimeError, ValueError) as e:
+            print(f"worker on {args.socket} not reachable: {e}")
+            return 1
+        frame = render_top(health, builds, args.socket)
+        if args.once or args.count:
+            print(frame, end="")
+        else:
+            print(_CLEAR + frame, end="", flush=True)
+        shown += 1
+        if frames and shown >= frames:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0

@@ -1,0 +1,309 @@
+"""Build-history store: one compact JSONL record per build, durable
+across processes — the first persistent perf-trajectory artifact.
+
+Every observability layer so far (metrics, events, traces, ledger,
+forensics) describes ONE build and dies with its files. A fleet needs
+the trajectory: is the warm rebuild getting slower, did the cache
+ratio regress after that refactor, which ISA route was this host on
+when the number moved. This module is that record.
+
+- ``--history-out FILE`` (or ``$MAKISU_TPU_HISTORY_DIR``, which
+  resolves to ``<dir>/history.jsonl``) makes ``cli.main`` append one
+  record per build/pull/push invocation: schema
+  ``makisu-tpu.history.v1``, wall duration, phase self-times (via
+  ``traceexport.phase_totals``), cache economics, bytes hashed per
+  backend, the native ISA route, backend/mode identity, exit code.
+  Appends are a single ``O_APPEND`` write per record, so concurrent
+  builds (a loadgen run, parallel CI jobs) can share one file without
+  interleaving partial lines.
+- ``makisu-tpu history PATH...`` renders the trend: per-record rows
+  plus duration/cache aggregates (p50/p99 via ``metrics.percentile``).
+- ``makisu-tpu history diff A B`` compares two history sets and FLAGS
+  regressions beyond ``--threshold`` (default 25%): duration p50/p99
+  growth, cache hit-ratio and chunk dedup-ratio drops. Exit code 1
+  when a regression is flagged — wired into CI as a perf gate.
+
+Like the rest of the telemetry layer: stdlib-only, and never able to
+fail a build (``cli.main`` guards the append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+from makisu_tpu.utils import events, metrics
+
+HISTORY_SCHEMA = "makisu-tpu.history.v1"
+
+# Default filename inside $MAKISU_TPU_HISTORY_DIR.
+HISTORY_BASENAME = "history.jsonl"
+
+# Regression gate metrics: (key, direction). "up" flags growth beyond
+# the threshold (latencies); "down" flags shrinkage (ratios where
+# bigger is better).
+_GATES: tuple[tuple[str, str], ...] = (
+    ("duration_p50", "up"),
+    ("duration_p99", "up"),
+    ("cache_hit_ratio", "down"),
+    ("chunk_dedup_ratio", "down"),
+)
+
+
+def resolve_out(flag: str) -> str:
+    """The history path this invocation appends to: the explicit
+    ``--history-out`` file wins; else ``$MAKISU_TPU_HISTORY_DIR/
+    history.jsonl``; else "" (history off)."""
+    if flag:
+        return flag
+    history_dir = os.environ.get("MAKISU_TPU_HISTORY_DIR", "")
+    if history_dir:
+        return os.path.join(history_dir, HISTORY_BASENAME)
+    return ""
+
+
+def record_from_report(report: dict, command: str = "",
+                       exit_code: int = 0,
+                       **extra: Any) -> dict:
+    """Distill one build's ``--metrics-out``-shaped report into the
+    compact history record. Everything here is derived from series the
+    registry already carries — history adds durability, not new
+    instrumentation."""
+    from makisu_tpu.utils import traceexport
+    top = traceexport.root_span(report)
+    duration = float((top or {}).get("duration") or 0.0)
+    cache = traceexport.cache_stats(report)
+    hashed = traceexport.bytes_hashed_by_backend(report)
+    chunk_added = chunk_reused = 0.0
+    for series in (report.get("counters") or {}).get(
+            "makisu_chunk_bytes_total", []):
+        value = float(series.get("value", 0.0))
+        if series.get("labels", {}).get("result") == "added":
+            chunk_added += value
+        elif series.get("labels", {}).get("result") == "reused":
+            chunk_reused += value
+    chunk_total = chunk_added + chunk_reused
+    info_labels: dict = {}
+    for series in (report.get("gauges") or {}).get(
+            "makisu_build_info", []):
+        info_labels = series.get("labels", {})
+        break
+    record = {
+        "schema": HISTORY_SCHEMA,
+        "ts": round(time.time(), 3),
+        "trace_id": report.get("trace_id", ""),
+        "command": command or report.get("command", ""),
+        "exit_code": exit_code,
+        "duration_seconds": round(duration, 6),
+        "phase_self_seconds": {
+            phase: round(seconds, 6)
+            for phase, seconds in
+            traceexport.phase_totals(report).items() if seconds},
+        "cache": {
+            "hits": int(cache["hit"]),
+            "misses": int(cache["miss"]),
+            "hit_ratio": round(cache["ratio"], 4),
+            "chunk_bytes_added": int(chunk_added),
+            "chunk_bytes_reused": int(chunk_reused),
+            "chunk_dedup_ratio": round(chunk_reused / chunk_total, 4)
+            if chunk_total else 0.0,
+        },
+        "bytes_hashed": {backend: int(n)
+                         for backend, n in sorted(hashed.items())},
+        "backend": info_labels.get("platform", ""),
+        "native_isa": info_labels.get("native_isa", ""),
+        "mode": info_labels.get("mode", ""),
+        "hasher": info_labels.get("hasher", ""),
+    }
+    record.update(extra)
+    return record
+
+
+def append_record(path: str, record: dict) -> None:
+    """Append one record as a single ``O_APPEND`` write (one line).
+    POSIX append semantics keep concurrent writers' lines whole —
+    loadgen's N simultaneous builds share one history file safely."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    line = json.dumps(record, separators=(",", ":"),
+                      default=str) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+
+
+def read_history(path: str) -> list[dict]:
+    """Load history records from a file, or every ``*.jsonl`` under a
+    directory, ordered by timestamp. Unknown-schema lines are skipped
+    (the set is open, like the event bus); torn final lines of a
+    killed build are salvaged like every other JSONL artifact."""
+    files: list[str]
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, name) for name in os.listdir(path)
+            if name.endswith(".jsonl"))
+    else:
+        files = [path]
+    records: list[dict] = []
+    for name in files:
+        for line in events.read_jsonl(name, skip_invalid=True):
+            if line.get("schema") == HISTORY_SCHEMA:
+                records.append(line)
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    return records
+
+
+# -- aggregation -----------------------------------------------------------
+
+
+def aggregate(records: list[dict]) -> dict:
+    """The digest ``history diff`` gates on: duration percentiles,
+    pooled cache hit ratio, pooled chunk dedup ratio."""
+    durations = [float(r.get("duration_seconds", 0.0))
+                 for r in records]
+    hits = sum(int(r.get("cache", {}).get("hits", 0)) for r in records)
+    misses = sum(int(r.get("cache", {}).get("misses", 0))
+                 for r in records)
+    added = sum(int(r.get("cache", {}).get("chunk_bytes_added", 0))
+                for r in records)
+    reused = sum(int(r.get("cache", {}).get("chunk_bytes_reused", 0))
+                 for r in records)
+    out: dict[str, Any] = {
+        "records": len(records),
+        "failures": sum(1 for r in records
+                        if int(r.get("exit_code", 0) or 0) != 0),
+        "cache_hit_ratio": round(hits / (hits + misses), 4)
+        if hits + misses else 0.0,
+        "chunk_dedup_ratio": round(reused / (added + reused), 4)
+        if added + reused else 0.0,
+    }
+    if durations:
+        out["duration_p50"] = round(
+            metrics.percentile(durations, 50), 6)
+        out["duration_p99"] = round(
+            metrics.percentile(durations, 99), 6)
+        out["duration_max"] = round(max(durations), 6)
+    return out
+
+
+def diff(a: list[dict], b: list[dict],
+         threshold: float = 0.25) -> dict:
+    """Compare history set ``b`` (candidate) against ``a`` (baseline)
+    and flag regressions beyond ``threshold`` (a fraction: 0.25 flags
+    a >25% p50 latency growth or a >25% relative hit-ratio drop).
+    Ratios with no samples on either side are skipped, not flagged."""
+    agg_a, agg_b = aggregate(a), aggregate(b)
+    regressions: list[dict] = []
+    if not a or not b:
+        # No records on one side = no signal, not a regression — an
+        # empty candidate file must fail loudly elsewhere (the caller
+        # sees records: 0 in the rendered diff), not masquerade as a
+        # 100% cache drop.
+        return {"baseline": agg_a, "candidate": agg_b,
+                "threshold": threshold, "regressions": [],
+                "ok": True, "insufficient_records": True}
+    for key, direction in _GATES:
+        va, vb = agg_a.get(key), agg_b.get(key)
+        if va is None or vb is None:
+            continue
+        if va <= 0:
+            # Nothing to regress from (no baseline samples, or a zero
+            # ratio): a gate needs a meaningful denominator.
+            continue
+        change = (vb - va) / va
+        flagged = (change > threshold if direction == "up"
+                   else change < -threshold)
+        if flagged:
+            regressions.append({
+                "metric": key,
+                "baseline": va,
+                "candidate": vb,
+                "change": round(change, 4),
+            })
+    return {
+        "baseline": agg_a,
+        "candidate": agg_b,
+        "threshold": threshold,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+# -- renderers -------------------------------------------------------------
+
+
+def _fmt_phases(phases: dict) -> str:
+    return " ".join(f"{name}={seconds:.2f}s"
+                    for name, seconds in sorted(
+                        phases.items(), key=lambda kv: -kv[1])[:3])
+
+
+def render_trends(records: list[dict], limit: int = 20) -> str:
+    """The ``makisu-tpu history PATH`` output: aggregate digest plus
+    the most recent ``limit`` records, oldest first."""
+    lines = [f"build history — {len(records)} records"]
+    if not records:
+        return lines[0] + "\n"
+    agg = aggregate(records)
+    lines.append(
+        f"duration p50 {agg.get('duration_p50', 0.0):.3f}s  "
+        f"p99 {agg.get('duration_p99', 0.0):.3f}s  "
+        f"max {agg.get('duration_max', 0.0):.3f}s")
+    lines.append(
+        f"cache hit ratio {100.0 * agg['cache_hit_ratio']:.1f}%  "
+        f"chunk dedup {100.0 * agg['chunk_dedup_ratio']:.1f}%  "
+        f"failures {agg['failures']}/{agg['records']}")
+    lines.append("")
+    shown = records[-limit:]
+    if len(records) > limit:
+        lines.append(f"(showing last {limit} of {len(records)})")
+    for r in shown:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(r.get("ts", 0.0)))
+        cache = r.get("cache", {})
+        lookups = cache.get("hits", 0) + cache.get("misses", 0)
+        cache_part = (f"cache {100.0 * cache.get('hit_ratio', 0.0):.0f}%"
+                      if lookups else "cache -")
+        code = int(r.get("exit_code", 0) or 0)
+        lines.append(
+            f"  {ts}  {r.get('command', '?'):<6s}"
+            f" {r.get('duration_seconds', 0.0):8.3f}s"
+            f"  {cache_part:<10s}"
+            f" {'ok' if code == 0 else f'exit {code}'}"
+            + (f"  [{_fmt_phases(r['phase_self_seconds'])}]"
+               if r.get("phase_self_seconds") else ""))
+    return "\n".join(lines) + "\n"
+
+
+def render_diff(result: dict) -> str:
+    """The ``makisu-tpu history diff A B`` output."""
+    agg_a, agg_b = result["baseline"], result["candidate"]
+    lines = [
+        "build history diff — baseline vs candidate "
+        f"(threshold {100.0 * result['threshold']:.0f}%)",
+        f"  records: {agg_a['records']} vs {agg_b['records']}",
+    ]
+    for key, _direction in _GATES:
+        va, vb = agg_a.get(key), agg_b.get(key)
+        if va is None or vb is None:
+            continue
+        flagged = any(r["metric"] == key
+                      for r in result["regressions"])
+        delta = ""
+        if va:
+            delta = f"  ({100.0 * (vb - va) / va:+.1f}%)"
+        lines.append(f"  {key:<18s} {va:10.4f} → {vb:10.4f}{delta}"
+                     + ("  ← REGRESSION" if flagged else ""))
+    lines.append("")
+    if result["regressions"]:
+        names = ", ".join(r["metric"] for r in result["regressions"])
+        lines.append(f"REGRESSION: {names} beyond the "
+                     f"{100.0 * result['threshold']:.0f}% threshold")
+    else:
+        lines.append("ok: no regression beyond the threshold")
+    return "\n".join(lines) + "\n"

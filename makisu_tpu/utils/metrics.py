@@ -66,6 +66,41 @@ def _label_key(labels: dict[str, Any]) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _nearest_rank(ordered, p: float) -> float:
+    rank = max(int(len(ordered) * p / 100.0 + 0.5), 1)
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+def percentile(values, p: float) -> float:
+    """Nearest-rank percentile of a sequence (p in [0, 100]). One
+    definition shared by the worker's queue stats, loadgen's report,
+    the history trends, and bench's warm-rebuild rounds — four
+    consumers quoting p50/p99 must agree on what those mean. Raises
+    on an empty sequence (callers gate on count)."""
+    ordered = sorted(values)
+    if not ordered:
+        raise ValueError("percentile of an empty sequence")
+    return _nearest_rank(ordered, p)
+
+
+def percentile_stats(values) -> dict[str, float]:
+    """``{"count", "p50", "p90", "p99", "max"}`` of a sequence —
+    the latency digest every load-observability surface exports
+    (``/healthz`` queue section, ``/builds``, loadgen reports,
+    ``history`` trends). Empty input yields ``{"count": 0}``. One
+    sort serves all three ranks."""
+    ordered = sorted(values)
+    if not ordered:
+        return {"count": 0}
+    return {
+        "count": len(ordered),
+        "p50": round(_nearest_rank(ordered, 50), 6),
+        "p90": round(_nearest_rank(ordered, 90), 6),
+        "p99": round(_nearest_rank(ordered, 99), 6),
+        "max": round(ordered[-1], 6),
+    }
+
+
 def new_id(nbytes: int) -> str:
     """Random lowercase-hex identifier of ``2 * nbytes`` characters.
     W3C trace ids are 16 bytes, span ids 8 (trace-context §3.2.2.3-4)."""

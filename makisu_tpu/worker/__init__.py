@@ -6,7 +6,14 @@ worker server is included too, so CI systems can keep one warm process
 (with its JAX kernels compiled) and feed it builds.
 """
 
-from makisu_tpu.worker.client import WorkerClient
+from makisu_tpu.worker.client import (
+    BuildInfo,
+    PercentileStats,
+    WorkerBuilds,
+    WorkerClient,
+    WorkerHealth,
+)
 from makisu_tpu.worker.server import WorkerServer
 
-__all__ = ["WorkerClient", "WorkerServer"]
+__all__ = ["BuildInfo", "PercentileStats", "WorkerBuilds",
+           "WorkerClient", "WorkerHealth", "WorkerServer"]

@@ -28,6 +28,152 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
         self.sock = sock
 
 
+class PercentileStats(dict):
+    """A ``metrics.percentile_stats`` digest with typed accessors.
+    Still a dict (wire-format compatible); missing fields read as 0."""
+
+    @property
+    def count(self) -> int:
+        return int(self.get("count", 0))
+
+    @property
+    def p50(self) -> float:
+        return float(self.get("p50", 0.0))
+
+    @property
+    def p90(self) -> float:
+        return float(self.get("p90", 0.0))
+
+    @property
+    def p99(self) -> float:
+        return float(self.get("p99", 0.0))
+
+
+class WorkerHealth(dict):
+    """``GET /healthz`` payload with typed queue/latency fields —
+    callers stop re-parsing raw dicts for the fields every dashboard
+    needs. Still a plain dict underneath, so existing subscript
+    consumers keep working unchanged."""
+
+    @property
+    def active_builds(self) -> int:
+        return int(self.get("active_builds", 0))
+
+    @property
+    def builds_started(self) -> int:
+        return int(self.get("builds_started", 0))
+
+    @property
+    def builds_succeeded(self) -> int:
+        return int(self.get("builds_succeeded", 0))
+
+    @property
+    def builds_failed(self) -> int:
+        return int(self.get("builds_failed", 0))
+
+    @property
+    def uptime_seconds(self) -> float:
+        return float(self.get("uptime_seconds", 0.0))
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self.get("queue", {}).get("depth", 0))
+
+    @property
+    def max_concurrent_builds(self) -> int:
+        return int(self.get("queue", {}).get(
+            "max_concurrent_builds", 0))
+
+    @property
+    def queue_wait(self) -> PercentileStats:
+        return PercentileStats(
+            self.get("queue", {}).get("wait_seconds", {}))
+
+    @property
+    def build_latency(self) -> PercentileStats:
+        return PercentileStats(
+            self.get("queue", {}).get("latency_seconds", {}))
+
+    @property
+    def tenant_latency(self) -> dict[str, PercentileStats]:
+        return {tenant: PercentileStats(stats)
+                for tenant, stats in self.get("queue", {}).get(
+                    "tenant_latency_seconds", {}).items()}
+
+    @property
+    def last_progress_seconds(self) -> float:
+        return float(self.get("last_progress_seconds", 0.0))
+
+    @property
+    def transfer_inflight_bytes(self) -> int:
+        return int(self.get("transfer_inflight_bytes", 0))
+
+
+class BuildInfo(dict):
+    """One row of ``GET /builds`` with typed accessors."""
+
+    @property
+    def id(self) -> int:
+        return int(self.get("id", 0))
+
+    @property
+    def tenant(self) -> str:
+        return str(self.get("tenant", ""))
+
+    @property
+    def state(self) -> str:
+        return str(self.get("state", ""))
+
+    @property
+    def phase(self) -> str:
+        return str(self.get("phase", ""))
+
+    @property
+    def trace_id(self) -> str:
+        return str(self.get("trace_id", ""))
+
+    @property
+    def queue_wait_seconds(self) -> float:
+        return float(self.get("queue_wait_seconds", 0.0))
+
+    @property
+    def age_seconds(self) -> float:
+        return float(self.get("age_seconds", 0.0))
+
+    @property
+    def progress_age_seconds(self) -> float:
+        return float(self.get("progress_age_seconds", 0.0))
+
+    @property
+    def exit_code(self) -> int | None:
+        code = self.get("exit_code")
+        return None if code is None else int(code)
+
+    @property
+    def cache_hit_ratio(self) -> float:
+        return float(self.get("cache", {}).get("kv_hit_ratio", 0.0))
+
+
+class WorkerBuilds(dict):
+    """``GET /builds`` payload: queue state + typed build rows."""
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self.get("queue_depth", 0))
+
+    @property
+    def max_concurrent_builds(self) -> int:
+        return int(self.get("max_concurrent_builds", 0))
+
+    @property
+    def inflight(self) -> list[BuildInfo]:
+        return [BuildInfo(b) for b in self.get("inflight", [])]
+
+    @property
+    def recent(self) -> list[BuildInfo]:
+        return [BuildInfo(b) for b in self.get("recent", [])]
+
+
 class WorkerClient:
     def __init__(self, socket_path: str,
                  local_shared_path: str = "",
@@ -44,11 +190,13 @@ class WorkerClient:
         # by the last build() call, in arrival order.
         self.last_events: list[dict] = []
 
-    def _request(self, method: str, path: str, body: bytes | None = None):
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 tenant: str = ""):
         conn = _UnixHTTPConnection(self.socket_path, self.timeout)
-        conn.request(method, path, body=body,
-                     headers={"Content-Type": "application/json"}
-                     if body else {})
+        headers = {"Content-Type": "application/json"} if body else {}
+        if tenant:
+            headers["X-Makisu-Tenant"] = tenant
+        conn.request(method, path, body=body, headers=headers)
         return conn, conn.getresponse()
 
     def ready(self) -> bool:
@@ -94,21 +242,36 @@ class WorkerClient:
         return os.path.join(self.worker_shared_path or
                             self.local_shared_path, name)
 
-    def healthz(self) -> dict:
-        """The worker's ``GET /healthz`` payload: uptime plus builds
-        started/succeeded/failed/active."""
+    def healthz(self) -> WorkerHealth:
+        """The worker's ``GET /healthz`` payload: uptime, build
+        outcome counts, and the admission queue's depth/latency
+        digests — typed via :class:`WorkerHealth` (still a dict)."""
         conn, resp = self._request("GET", "/healthz")
         try:
             if resp.status != 200:
                 raise RuntimeError(
                     f"worker /healthz returned {resp.status}")
-            return json.loads(resp.read())
+            return WorkerHealth(json.loads(resp.read()))
+        finally:
+            conn.close()
+
+    def builds(self) -> WorkerBuilds:
+        """The worker's ``GET /builds`` payload: in-flight + recently
+        finished builds (tenant, phase, queue wait, progress age,
+        cache economics) plus queue depth/cap."""
+        conn, resp = self._request("GET", "/builds")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /builds returned {resp.status}")
+            return WorkerBuilds(json.loads(resp.read()))
         finally:
             conn.close()
 
     def build(self, argv: list[str],
               context_dir: str | None = None,
-              on_line=None, on_event=None) -> int:
+              on_line=None, on_event=None,
+              tenant: str = "") -> int:
         """Submit a build; stream log lines to the local logger (and
         ``on_line(payload)`` when given); return the worker's build exit
         code.
@@ -116,14 +279,19 @@ class WorkerClient:
         The response stream carries three frame types, all NDJSON:
         log lines, build events (``{"event": {...}}`` — collected into
         ``last_events`` and forwarded to ``on_event`` when given), and
-        the terminal outcome (``{"build_code": ...}``)."""
+        the terminal outcome (``{"build_code": ...}`` — also carrying
+        ``queue_wait_seconds`` + ``tenant``, see ``last_build``).
+
+        ``tenant`` labels this build in the worker's queue/latency
+        telemetry (sent as the ``X-Makisu-Tenant`` header)."""
         if context_dir is not None:
             worker_ctx = self.prepare_context(context_dir)
             argv = list(argv) + [worker_ctx]
         self.last_build = {}  # stale outcome must not survive a retry
         self.last_events = []
         conn, resp = self._request("POST", "/build",
-                                   json.dumps(argv).encode())
+                                   json.dumps(argv).encode(),
+                                   tenant=tenant)
         build_code = 1
         try:
             if resp.status != 200:

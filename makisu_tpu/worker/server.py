@@ -2,17 +2,29 @@
 
 Protocol (reference: lib/client/client.go):
 - GET  /ready  → 200 when accepting builds
-- POST /build  → body is a JSON argv list for the build command; the
-  response streams newline-delimited JSON frames — log lines, build
-  events (``{"event": {...}}``), and the terminal
-  ``{"build_code": "<exit code>", ...}``
+- POST /build  → body is a JSON argv list for the build command (or
+  ``{"argv": [...], "tenant": "..."}``; the ``X-Makisu-Tenant`` header
+  also names the tenant); the response streams newline-delimited JSON
+  frames — log lines, build events (``{"event": {...}}``), and the
+  terminal ``{"build_code": "<exit code>", ...}``
 - GET  /metrics → Prometheus text of the process-global registry
-- GET  /healthz → uptime + builds started/succeeded/failed/active
+- GET  /healthz → uptime + builds started/succeeded/failed/active +
+  the admission queue's depth and wait/latency percentiles
+- GET  /builds → in-flight + recently finished builds as JSON (trace
+  id, tenant, phase, queue wait, progress age, cache economics)
 - GET  /exit   → 200, then the server shuts down
+
+Admission: ``--max-concurrent-builds N`` caps concurrently EXECUTING
+builds; arrivals beyond the cap wait in an explicit FIFO queue in
+front of build execution. The queue is instrumented (depth gauge,
+wait/latency histograms with per-tenant labels) — the signals a fleet
+scheduler needs before it can route by cache affinity or enforce
+fairness (ROADMAP item 1).
 """
 
 from __future__ import annotations
 
+import collections
 import io
 import json
 import os
@@ -24,6 +36,217 @@ from http.server import BaseHTTPRequestHandler
 
 # Prometheus text exposition content type (format 0.0.4).
 _METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Histogram buckets for queue wait / build latency: builds span four
+# orders of magnitude (sub-second scratch builds to multi-minute
+# 100k-file trees), so the default millisecond ladder is too fine.
+_LATENCY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                    120.0, 300.0, 600.0, 1800.0)
+
+# Finished-build ring size for GET /builds "recent".
+_RECENT_BUILDS_KEEP = 32
+
+# Cap on distinct tenant label values in the latency rings and the
+# process registry's histograms. The tenant string is CLIENT-supplied
+# (X-Makisu-Tenant); without a cap, a buggy client stamping unique
+# strings would grow per-tenant rings, /metrics series, and the
+# /healthz payload without bound in a long-lived worker (the same
+# cardinality discipline makisu_chunk_dedup_ratio applies). Tenants
+# past the cap aggregate under "other".
+_TENANT_LABELS_KEEP = 32
+_TENANT_OVERFLOW = "other"
+
+
+class _QuantileRing:
+    """Bounded ring of raw observations with exact percentile export.
+    The Prometheus histograms cover scrape-time quantiles; this ring is
+    what ``/healthz`` and ``/builds`` serve — exact p50/p90/p99 over
+    the last N builds, no bucket interpolation error."""
+
+    def __init__(self, cap: int = 512) -> None:
+        self._vals: collections.deque[float] = collections.deque(
+            maxlen=cap)
+        self._mu = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._mu:
+            self._vals.append(value)
+
+    def stats(self) -> dict:
+        from makisu_tpu.utils import metrics
+        with self._mu:
+            vals = list(self._vals)
+        return metrics.percentile_stats(vals)
+
+
+class _AdmissionQueue:
+    """FIFO admission in front of build execution. ``limit <= 0``
+    means unlimited (acquire never blocks). Slots transfer directly to
+    the oldest waiter on release, so admission order is strictly
+    arrival order — a fairness property a semaphore does not give."""
+
+    def __init__(self, limit: int) -> None:
+        self.limit = limit
+        self._mu = threading.Lock()
+        self._waiters: collections.deque[threading.Event] = \
+            collections.deque()
+        self._running = 0
+
+    def _publish_depth(self) -> None:
+        # Global registry explicitly: admission runs on handler threads
+        # before/after any per-build registry is bound, and the gauge
+        # is a process-level vital sign either way.
+        from makisu_tpu.utils import metrics
+        metrics.global_registry().gauge_set(
+            "makisu_worker_queue_depth", len(self._waiters))
+
+    def acquire(self) -> float:
+        """Block until a slot frees (FIFO); returns seconds waited."""
+        if self.limit <= 0:
+            return 0.0
+        t0 = time.monotonic()
+        with self._mu:
+            if self._running < self.limit and not self._waiters:
+                self._running += 1
+                return 0.0
+            gate = threading.Event()
+            self._waiters.append(gate)
+            self._publish_depth()
+        gate.wait()
+        return time.monotonic() - t0
+
+    def release(self) -> None:
+        if self.limit <= 0:
+            return
+        with self._mu:
+            if self._waiters:
+                # The slot transfers: _running stays constant.
+                self._waiters.popleft().set()
+                self._publish_depth()
+            else:
+                self._running -= 1
+
+    def depth(self) -> int:
+        with self._mu:
+            return len(self._waiters)
+
+
+class _BuildRecord:
+    """One build's row in ``GET /builds``: identity, queue state, and
+    a live telemetry digest fed by the build's own event stream (an
+    extra event sink — trace id from ``build_start``, phase from
+    ``span_start``, progress age from any event, cache economics
+    accumulated from ``cache_decision`` events via the PR 6 ledger
+    summary)."""
+
+    def __init__(self, seq: int, tenant: str, argv: list[str]) -> None:
+        from makisu_tpu.utils import ledger
+        self.seq = seq
+        self.tenant = tenant
+        self.command = next(
+            (a for a in argv if not a.startswith("-")), "")
+        self.tag = self._tag_of(argv)
+        self.state = "queued"
+        self.trace_id = ""
+        self.phase = ""
+        self.exit_code: int | None = None
+        self.queue_wait_seconds = 0.0
+        self.enqueued_mono = time.monotonic()
+        self.started_mono: float | None = None
+        self.finished_mono: float | None = None
+        self._last_event_mono = self.enqueued_mono
+        self._mu = threading.Lock()
+        self._ledger = ledger.LedgerSummary()
+
+    @staticmethod
+    def _tag_of(argv: list[str]) -> str:
+        for i, arg in enumerate(argv):
+            if arg in ("-t", "--tag") and i + 1 < len(argv):
+                return argv[i + 1]
+            if arg.startswith("--tag="):
+                return arg.split("=", 1)[1]
+        return ""
+
+    def note_event(self, event: dict) -> None:
+        """Event-bus sink: cheap field updates under a record lock
+        (the build's own threads emit concurrently)."""
+        from makisu_tpu.utils import ledger as ledger_mod
+        from makisu_tpu.utils import traceexport
+        etype = event.get("type")
+        with self._mu:
+            self._last_event_mono = time.monotonic()
+            if etype == "build_start":
+                self.trace_id = event.get("trace_id", "")
+            elif etype == "span_start":
+                phase = traceexport.phase_of(event.get("name", ""))
+                if phase != "other":
+                    self.phase = phase
+            elif etype == ledger_mod.EVENT_TYPE:
+                self._ledger.add(event)
+
+    def start_running(self, queue_wait: float) -> None:
+        with self._mu:
+            self.state = "running"
+            self.queue_wait_seconds = queue_wait
+            self.started_mono = time.monotonic()
+            self._last_event_mono = self.started_mono
+
+    def finish(self, exit_code: int) -> None:
+        with self._mu:
+            self.state = "finished"
+            self.exit_code = exit_code
+            self.finished_mono = time.monotonic()
+
+    def latency_seconds(self) -> float:
+        """Queue wait + execution: arrival to completion."""
+        end = self.finished_mono or time.monotonic()
+        return end - self.enqueued_mono
+
+    def to_dict(self) -> dict:
+        now = time.monotonic()
+        with self._mu:
+            kv = self._ledger.by_source.get("kv", {})
+            hits = kv.get("hit", 0)
+            consults = sum(kv.values())
+            out = {
+                "id": self.seq,
+                "tenant": self.tenant,
+                "state": self.state,
+                "command": self.command,
+                "tag": self.tag,
+                "trace_id": self.trace_id,
+                "phase": self.phase,
+                "queue_wait_seconds": round(
+                    self.queue_wait_seconds
+                    if self.started_mono is not None
+                    else now - self.enqueued_mono, 3),
+                "age_seconds": round(
+                    (self.finished_mono or now) - self.enqueued_mono,
+                    3),
+                # Seconds since the build's own event stream last moved
+                # — the per-build progress clock a fleet `top` watches
+                # for wedged builds.
+                "progress_age_seconds": round(
+                    (self.finished_mono or now)
+                    - self._last_event_mono, 3),
+                "cache": {
+                    "kv_hits": hits,
+                    "kv_consults": consults,
+                    "kv_hit_ratio": round(hits / consults, 4)
+                    if consults else 0.0,
+                    "bytes_added": self._ledger.bytes_added,
+                    "bytes_reused": self._ledger.bytes_reused,
+                    "dedup_ratio": round(
+                        self._ledger.dedup_ratio(), 4),
+                },
+            }
+            if self.exit_code is not None:
+                out["exit_code"] = self.exit_code
+            if self.finished_mono is not None \
+                    and self.started_mono is not None:
+                out["elapsed_seconds"] = round(
+                    self.finished_mono - self.started_mono, 3)
+            return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -48,6 +271,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200,
                           json.dumps(self.server.health()).encode(),
                           content_type="application/json")
+        elif self.path == "/builds":
+            # The operator's (and `makisu-tpu top`'s) live view:
+            # every in-flight build plus the recently finished ring.
+            self._respond(200,
+                          json.dumps(self.server.builds()).encode(),
+                          content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -63,8 +292,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", "0"))
         try:
-            argv = json.loads(self.rfile.read(length))
+            body = json.loads(self.rfile.read(length))
         except ValueError:
+            self._respond(400, b"bad argv json")
+            return
+        # Two body shapes: the legacy bare argv list, and the object
+        # form ``{"argv": [...], "tenant": "..."}``. The header wins
+        # when both name a tenant (proxies inject headers; bodies come
+        # from the original submitter).
+        tenant = ""
+        if isinstance(body, dict):
+            argv = body.get("argv") or []
+            tenant = str(body.get("tenant") or "")
+        else:
+            argv = body
+        tenant = self.headers.get("X-Makisu-Tenant") or tenant
+        if not isinstance(argv, list) or not all(
+                isinstance(a, str) for a in argv):
             self._respond(400, b"bad argv json")
             return
         self.send_response(200)
@@ -90,15 +334,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(frame)
 
         start = time.monotonic()
-        code = self.server.run_build(argv, emit)
-        # Terminal line carries the outcome as DATA — exit code and
-        # elapsed seconds — so clients never parse log text for it.
+        record = self.server.register_build(argv, tenant)
+        code = self.server.run_build(argv, emit, record)
+        # Terminal line carries the outcome as DATA — exit code,
+        # elapsed seconds, and the admission split (queue wait vs
+        # execution) — so clients never parse log text for it.
         # "build_code" (stringly) predates "exit_code"; kept for older
         # clients.
         emit(json.dumps({
             "build_code": str(code),
             "exit_code": code,
             "elapsed_seconds": round(time.monotonic() - start, 3),
+            "queue_wait_seconds": round(record.queue_wait_seconds, 3),
+            "tenant": tenant,
         }))
         with emit_lock:
             finished.set()
@@ -176,7 +424,8 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
 
     def __init__(self, socket_path: str,
                  stall_window: float | None = None,
-                 diag_out: str = "") -> None:
+                 diag_out: str = "",
+                 max_concurrent_builds: int = 0) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
@@ -188,6 +437,30 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         self._builds_started = 0
         self._builds_succeeded = 0
         self._builds_failed = 0
+        # Admission control: cap concurrently EXECUTING builds, FIFO
+        # beyond the cap. 0/unset = unlimited (the pre-fleet default);
+        # env MAKISU_TPU_MAX_CONCURRENT_BUILDS configures deployments
+        # whose supervisor can't pass flags.
+        if max_concurrent_builds <= 0:
+            try:
+                max_concurrent_builds = int(os.environ.get(
+                    "MAKISU_TPU_MAX_CONCURRENT_BUILDS", "0") or 0)
+            except ValueError:
+                max_concurrent_builds = 0
+        self.max_concurrent_builds = max_concurrent_builds
+        self._admission = _AdmissionQueue(max_concurrent_builds)
+        # GET /builds state: every accepted build gets a record that
+        # lives in _inflight until it finishes, then rides the bounded
+        # recent ring. Latency digests (exact, last-512) back the
+        # /healthz queue section.
+        self._builds_mu = threading.Lock()
+        self._build_seq = 0
+        self._inflight: dict[int, _BuildRecord] = {}
+        self._recent: collections.deque[_BuildRecord] = \
+            collections.deque(maxlen=_RECENT_BUILDS_KEEP)
+        self._queue_wait_ring = _QuantileRing()
+        self._latency_ring = _QuantileRing()
+        self._tenant_latency: dict[str, _QuantileRing] = {}
         # Builds from all connections share one process — and therefore
         # one HashService, so chunk hashing from concurrent builds
         # batches onto full device programs (the build-farm scenario).
@@ -245,7 +518,60 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         request, _ = super().get_request()
         return request, ("worker", 0)
 
-    def run_build(self, argv: list[str], emit) -> int:
+    def register_build(self, argv: list[str],
+                       tenant: str = "") -> _BuildRecord:
+        """Create this build's ``/builds`` record (state=queued). The
+        record exists BEFORE admission, so a build waiting in the FIFO
+        is visible to ``top`` with a growing queue wait."""
+        with self._builds_mu:
+            self._build_seq += 1
+            record = _BuildRecord(self._build_seq, tenant, argv)
+            self._inflight[record.seq] = record
+        return record
+
+    def _retire_build(self, record: _BuildRecord, code: int) -> None:
+        record.finish(code)
+        latency = record.latency_seconds()
+        self._queue_wait_ring.add(record.queue_wait_seconds)
+        self._latency_ring.add(latency)
+        with self._builds_mu:
+            self._inflight.pop(record.seq, None)
+            self._recent.append(record)
+            tenant = record.tenant
+            if (tenant not in self._tenant_latency
+                    and len(self._tenant_latency)
+                    >= _TENANT_LABELS_KEEP):
+                tenant = _TENANT_OVERFLOW
+            ring = self._tenant_latency.setdefault(
+                tenant, _QuantileRing())
+        ring.add(latency)
+        # Prometheus histograms (scrape-side quantiles, per-tenant
+        # fairness series); the rings above serve /healthz exactly.
+        # Same capped tenant label: the process registry's series set
+        # must stay bounded for a long-lived worker's /metrics.
+        from makisu_tpu.utils import metrics
+        g = metrics.global_registry()
+        g.observe("makisu_build_queue_wait_seconds",
+                  record.queue_wait_seconds,
+                  buckets=_LATENCY_BUCKETS, tenant=tenant)
+        g.observe("makisu_build_latency_seconds", latency,
+                  buckets=_LATENCY_BUCKETS, tenant=tenant)
+
+    def builds(self) -> dict:
+        """The ``GET /builds`` payload."""
+        with self._builds_mu:
+            inflight = sorted(self._inflight.values(),
+                              key=lambda r: r.seq)
+            recent = list(self._recent)
+        return {
+            "queue_depth": self._admission.depth(),
+            "max_concurrent_builds": self.max_concurrent_builds,
+            "inflight": [r.to_dict() for r in inflight],
+            "recent": [r.to_dict() for r in reversed(recent)],
+        }
+
+    def run_build(self, argv: list[str], emit,
+                  record: _BuildRecord | None = None) -> int:
         """Run one build command in-process, forwarding log lines and
         build events.
 
@@ -254,7 +580,12 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         stay separate — client A never sees client B's log lines or
         events. Events ride the same chunked NDJSON stream as their own
         frame type, ``{"event": {...}}``, so a client watches the
-        build's structure (spans, steps, cache outcomes) live."""
+        build's structure (spans, steps, cache outcomes) live.
+
+        Admission happens here: past ``--max-concurrent-builds``
+        executing builds, the request thread waits its FIFO turn. The
+        wait lands on ``record`` (queue split in the terminal frame,
+        queue-wait histograms, ``/builds``)."""
         from makisu_tpu import cli
         from makisu_tpu.utils import events, metrics
         from makisu_tpu.utils import logging as log
@@ -271,11 +602,16 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             except OSError:
                 pass  # client went away; keep building
 
+        if record is None:  # direct callers (tests) skip do_POST
+            record = self.register_build(argv)
+        queue_wait = self._admission.acquire()
+        record.start_running(queue_wait)
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
         level = _effective_flags(argv)["log_level"]
         token = log.set_build_sink(sink, level.replace("warn", "warning"))
         events_token = events.add_sink(event_sink)
+        record_token = events.add_sink(record.note_event)
         mode_token = cli.invocation_mode.set("worker")
         # Count the build started BEFORE acquiring shared-path locks:
         # a build wedged waiting on another build's --root/--storage
@@ -323,7 +659,10 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                     - self._builds_failed)
             for lock in reversed(locks):
                 lock.release()
+            self._admission.release()
+            self._retire_build(record, code)
             cli.invocation_mode.reset(mode_token)
+            events.reset_sink(record_token)
             events.reset_sink(events_token)
             log.reset_build_sink(token)
 
@@ -368,6 +707,22 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 chunk_reused / (chunk_added + chunk_reused), 4)
                 if (chunk_added + chunk_reused) else 0.0,
         }
+        # Admission-queue vitals: depth, the concurrency cap, and exact
+        # wait/latency percentiles over recent builds (overall + per
+        # tenant) — the fairness signal `loadgen` and a fleet scheduler
+        # read. Rings are exact over the last 512 builds; the
+        # Prometheus histograms carry the full-history series.
+        with self._builds_mu:
+            tenant_rings = dict(self._tenant_latency)
+        queue = {
+            "depth": self._admission.depth(),
+            "max_concurrent_builds": self.max_concurrent_builds,
+            "wait_seconds": self._queue_wait_ring.stats(),
+            "latency_seconds": self._latency_ring.stats(),
+            "tenant_latency_seconds": {
+                tenant: ring.stats()
+                for tenant, ring in sorted(tenant_rings.items())},
+        }
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -376,6 +731,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "builds_succeeded": succeeded,
             "builds_failed": failed,
             "active_builds": started - succeeded - failed,
+            "queue": queue,
             "cache": cache,
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on

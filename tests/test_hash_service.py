@@ -161,3 +161,32 @@ def test_full_build_with_shared_hasher(tmp_path, service):
         assert manifest.layers
     finally:
         svc_mod._global_service = orig
+
+
+def test_batch_occupancy_metric(service):
+    """Every dispatched batch observes makisu_hash_batch_occupancy
+    (lanes filled ÷ lane capacity) — the fleet-batching signal a
+    scheduler reads to know whether concurrency is filling device
+    programs. Dispatcher threads run outside any build context, so
+    the series lands in the process-global registry."""
+    from makisu_tpu.utils import metrics
+
+    def occupancy_hist():
+        report = metrics.global_registry().report()
+        series = report["histograms"].get(
+            "makisu_hash_batch_occupancy", [])
+        return (sum(s["count"] for s in series),
+                sum(s["sum"] for s in series))
+
+    count_before, _sum_before = occupancy_hist()
+    payloads = [np.random.default_rng(400 + i).integers(
+        0, 256, size=4000, dtype=np.uint8).tobytes()
+        for i in range(8)]
+    for p, fut in [(p, service.submit(p)) for p in payloads]:
+        assert fut.result(timeout=60) == hashlib.sha256(p).digest()
+    count_after, sum_after = occupancy_hist()
+    batches = count_after - count_before
+    assert batches >= 1
+    assert batches == service.batches
+    # Occupancy is a fraction of lane capacity: (0, 1] per batch.
+    assert 0 < sum_after / count_after <= 1.0

@@ -1,0 +1,238 @@
+"""Build-history store: record round-trip through a real build,
+trend rendering, and the `history diff` regression gate."""
+
+import json
+import threading
+
+import pytest
+
+from makisu_tpu import cli
+from makisu_tpu.utils import history
+
+
+def _synthetic(duration: float, i: int = 0, hits: int = 8,
+               misses: int = 2, reused: int = 90,
+               added: int = 10) -> dict:
+    return {
+        "schema": history.HISTORY_SCHEMA,
+        "ts": 1_700_000_000.0 + i,
+        "trace_id": f"{i:032x}",
+        "command": "build",
+        "exit_code": 0,
+        "duration_seconds": duration,
+        "phase_self_seconds": {"hash": duration * 0.6},
+        "cache": {"hits": hits, "misses": misses,
+                  "hit_ratio": hits / (hits + misses),
+                  "chunk_bytes_added": added,
+                  "chunk_bytes_reused": reused,
+                  "chunk_dedup_ratio": reused / (added + reused)},
+        "bytes_hashed": {"native": 1000},
+        "backend": "cpu", "native_isa": "", "mode": "standalone",
+        "hasher": "tpu",
+    }
+
+
+def _write(path, records):
+    for r in records:
+        history.append_record(str(path), r)
+
+
+def _build(tmp_path, name, extra_argv=()):
+    ctx = tmp_path / f"{name}-ctx"
+    if not ctx.exists():
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text(
+            "FROM scratch\nCOPY data /data\n")
+        (ctx / "data").write_text("history payload\n" * 2048)
+        (tmp_path / f"{name}-root").mkdir()
+    return cli.main(list(extra_argv) + [
+        "--log-level", "error", "build", str(ctx),
+        "-t", f"hist/{name}:1", "--hasher", "tpu",
+        "--storage", str(tmp_path / f"{name}-storage"),
+        "--root", str(tmp_path / f"{name}-root")])
+
+
+# -- round trip through a real build ---------------------------------------
+
+
+def test_build_appends_history_record(tmp_path):
+    out = tmp_path / "hist.jsonl"
+    assert _build(tmp_path, "rt",
+                  ["--history-out", str(out)]) == 0
+    assert _build(tmp_path, "rt",
+                  ["--history-out", str(out)]) == 0  # warm append
+    records = history.read_history(str(out))
+    assert len(records) == 2
+    cold, warm = records
+    for r in records:
+        assert r["schema"] == history.HISTORY_SCHEMA
+        assert r["command"] == "build"
+        assert r["exit_code"] == 0
+        assert r["duration_seconds"] > 0
+        assert len(r["trace_id"]) == 32
+        assert r["mode"] == "standalone"
+        assert r["hasher"] == "tpu"
+        assert r["phase_self_seconds"]  # traceexport split present
+    # The warm rebuild hit the cache; the cold one could not (and a
+    # full-hit rebuild hashes zero bytes — that IS the cache working).
+    assert sum(cold["bytes_hashed"].values()) > 0
+    assert cold["cache"]["hits"] == 0
+    assert warm["cache"]["hits"] > 0
+    assert warm["cache"]["hit_ratio"] > 0
+    # Distinct builds, ordered by time.
+    assert cold["trace_id"] != warm["trace_id"]
+    assert cold["ts"] <= warm["ts"]
+
+
+def test_history_dir_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_HISTORY_DIR",
+                       str(tmp_path / "histdir"))
+    assert _build(tmp_path, "env") == 0
+    out = tmp_path / "histdir" / history.HISTORY_BASENAME
+    assert out.exists()
+    assert len(history.read_history(str(tmp_path / "histdir"))) == 1
+    # The explicit flag wins over the env dir.
+    flagged = tmp_path / "flagged.jsonl"
+    assert _build(tmp_path, "env",
+                  ["--history-out", str(flagged)]) == 0
+    assert len(history.read_history(str(flagged))) == 1
+    assert len(history.read_history(str(out))) == 1
+
+
+def test_resolve_out(monkeypatch):
+    monkeypatch.delenv("MAKISU_TPU_HISTORY_DIR", raising=False)
+    assert history.resolve_out("") == ""
+    assert history.resolve_out("/x/f.jsonl") == "/x/f.jsonl"
+    monkeypatch.setenv("MAKISU_TPU_HISTORY_DIR", "/var/hist")
+    assert history.resolve_out("") == \
+        "/var/hist/" + history.HISTORY_BASENAME
+    assert history.resolve_out("/x/f.jsonl") == "/x/f.jsonl"
+
+
+def test_concurrent_appends_stay_whole(tmp_path):
+    """N threads appending to ONE history file (the loadgen shape)
+    leave N parseable records — O_APPEND single-write discipline."""
+    out = tmp_path / "c.jsonl"
+    threads = [
+        threading.Thread(target=_write, args=(
+            out, [_synthetic(1.0, i * 10 + j) for j in range(10)]))
+        for i in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(history.read_history(str(out))) == 50
+
+
+def test_read_history_skips_foreign_lines(tmp_path):
+    out = tmp_path / "m.jsonl"
+    out.write_text(json.dumps(_synthetic(1.0)) + "\n"
+                   + '{"schema": "other.v1"}\n'
+                   + "not json at all\n"
+                   + json.dumps(_synthetic(2.0, 1)) + "\n")
+    records = history.read_history(str(out))
+    assert [r["duration_seconds"] for r in records] == [1.0, 2.0]
+
+
+# -- aggregation + the regression gate -------------------------------------
+
+
+def test_aggregate():
+    records = [_synthetic(1.0 + i * 0.1, i) for i in range(10)]
+    records[3]["exit_code"] = 1
+    agg = history.aggregate(records)
+    assert agg["records"] == 10
+    assert agg["failures"] == 1
+    assert agg["duration_p50"] == pytest.approx(1.4)
+    assert agg["duration_p99"] == pytest.approx(1.9)
+    assert agg["cache_hit_ratio"] == pytest.approx(0.8)
+    assert agg["chunk_dedup_ratio"] == pytest.approx(0.9)
+
+
+def test_diff_flags_2x_latency_regression(tmp_path):
+    """The acceptance gate: an injected 2x latency regression between
+    two history files is flagged, and the CLI exits 1 on it."""
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _write(a, [_synthetic(1.0 + i * 0.01, i) for i in range(10)])
+    _write(b, [_synthetic(2.0 + i * 0.01, i) for i in range(10)])
+    result = history.diff(history.read_history(str(a)),
+                          history.read_history(str(b)))
+    assert not result["ok"]
+    flagged = {r["metric"] for r in result["regressions"]}
+    assert "duration_p50" in flagged and "duration_p99" in flagged
+    text = history.render_diff(result)
+    assert "← REGRESSION" in text
+    assert "REGRESSION: duration_p50" in text
+    # CLI gate: exit 1 on regression, 0 on parity; rendered output.
+    assert cli.main(["history", "diff", str(a), str(b)]) == 1
+    assert cli.main(["history", "diff", str(a), str(a)]) == 0
+    # Reversed (candidate got FASTER): not a regression.
+    assert cli.main(["history", "diff", str(b), str(a)]) == 0
+
+
+def test_diff_flags_cache_ratio_drop(tmp_path):
+    a = [_synthetic(1.0, i) for i in range(5)]
+    b = [_synthetic(1.0, i, hits=2, misses=8, reused=10, added=90)
+         for i in range(5)]
+    result = history.diff(a, b)
+    flagged = {r["metric"] for r in result["regressions"]}
+    assert flagged == {"cache_hit_ratio", "chunk_dedup_ratio"}
+
+
+def test_diff_threshold_respected():
+    a = [_synthetic(1.0, i) for i in range(5)]
+    b = [_synthetic(1.2, i) for i in range(5)]  # +20%
+    assert history.diff(a, b, threshold=0.25)["ok"]
+    assert not history.diff(a, b, threshold=0.15)["ok"]
+
+
+def test_diff_empty_sides_do_not_flag():
+    assert history.diff([], [_synthetic(5.0)])["ok"]
+    assert history.diff([_synthetic(5.0)], [])["ok"]
+
+
+def test_history_trend_render(tmp_path):
+    out = tmp_path / "t.jsonl"
+    _write(out, [_synthetic(1.0 + i * 0.5, i) for i in range(4)])
+    text = history.render_trends(history.read_history(str(out)))
+    assert "4 records" in text
+    assert "duration p50" in text and "p99" in text
+    assert "cache hit ratio 80.0%" in text
+    assert text.count("build") >= 4
+    # CLI render path.
+    assert cli.main(["history", str(out)]) == 0
+
+
+def test_history_diff_bad_usage():
+    with pytest.raises(SystemExit):
+        cli.main(["history", "diff", "only-one"])
+
+
+def test_percentile_helpers():
+    from makisu_tpu.utils import metrics
+    vals = list(range(1, 101))
+    assert metrics.percentile(vals, 50) == 50
+    assert metrics.percentile(vals, 99) == 99
+    assert metrics.percentile([7.0], 99) == 7.0
+    stats = metrics.percentile_stats([3.0, 1.0, 2.0])
+    assert stats == {"count": 3, "p50": 2.0, "p90": 3.0, "p99": 3.0,
+                     "max": 3.0}
+    assert metrics.percentile_stats([]) == {"count": 0}
+    with pytest.raises(ValueError):
+        metrics.percentile([], 50)
+
+
+def test_history_missing_path_exits_2(tmp_path):
+    """A missing/unreadable history file exits 2 with a clean error —
+    never a traceback, and never exit 1 (which means 'regression
+    flagged' to a gate script)."""
+    good = tmp_path / "good.jsonl"
+    _write(good, [_synthetic(1.0)])
+    for argv in (["history", str(tmp_path / "absent.jsonl")],
+                 ["history", "diff", str(tmp_path / "absent.jsonl"),
+                  str(good)],
+                 ["history", "diff", str(good),
+                  str(tmp_path / "absent.jsonl")]):
+        with pytest.raises(SystemExit) as exc:
+            cli.main(argv)
+        assert exc.value.code == 2

@@ -1,0 +1,247 @@
+"""`makisu-tpu loadgen`: the synthetic concurrent-build harness, run
+against a real in-process worker, plus its report plumbing units."""
+
+import json
+
+from makisu_tpu import cli
+from makisu_tpu.tools import loadgen
+from makisu_tpu.worker import WorkerClient, WorkerServer
+
+
+def _loadgen_args(extra):
+    return cli.make_parser().parse_args(
+        ["--log-level", "error", "loadgen"] + extra)
+
+
+def test_loadgen_smoke_against_live_worker(tmp_path):
+    """A small loadgen run against a live (in-process) worker: every
+    build succeeds, and the report carries the acceptance surface —
+    p50/p99 latency, the queue-wait/execution split, per-tenant
+    fairness, and /builds observed in-flight during the run."""
+    server = WorkerServer(str(tmp_path / "lg.sock"),
+                          max_concurrent_builds=2)
+    server.serve_background()
+    report_path = tmp_path / "report.json"
+    try:
+        args = _loadgen_args([
+            "--socket", server.socket_path,
+            "--concurrency", "3", "--builds", "6",
+            "--files", "4", "--file-kb", "1",
+            "--edit-churn", "0.5",
+            "--tenants", "red,blue",
+            "--poll-interval", "0.05",
+            "--report", str(report_path),
+            "--work-dir", str(tmp_path / "work"),
+        ])
+        assert loadgen.run(args) == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+
+    report = json.loads(report_path.read_text())
+    assert report["schema"] == "makisu-tpu.loadgen.v1"
+    assert report["builds"] == 6
+    assert report["failures"] == 0
+    # Latency digest: p50/p99 present and ordered.
+    lat = report["latency_seconds"]
+    assert lat["count"] == 6
+    assert 0 < lat["p50"] <= lat["p99"]
+    # The split: queue wait + execution ≈ latency per build.
+    for row in report["results"]:
+        assert row["exit_code"] == 0
+        assert row["latency_seconds"] >= row["queue_wait_seconds"]
+        assert abs(row["queue_wait_seconds"] + row["exec_seconds"]
+                   - row["latency_seconds"]) < 0.05
+    # Per-tenant digests and the fairness ratio.
+    tenants = report["tenant_latency_seconds"]
+    assert set(tenants) == {"red", "blue"}
+    assert sum(s["count"] for s in tenants.values()) == 6
+    assert report["tenant_fairness_p99_ratio"] >= 1.0
+    # /builds reflected in-flight builds DURING the run.
+    assert report["saw_running_build"]
+    assert report["peak_inflight"] >= 1
+    # With 3 lanes against a cap of 2, someone queued.
+    assert report["peak_queue_depth"] >= 1 \
+        or report["queue_wait_seconds"]["max"] > 0
+    # The trajectory sampled the worker's cache economics.
+    assert report["cache_trajectory"]
+    last = report["cache_trajectory"][-1]
+    assert last["cache_hits"] + last["cache_misses"] > 0
+    # Warm rebuilds (edit churn leaves base/ intact) hit the cache.
+    assert last["cache_hits"] > 0
+    # The worker served exactly these builds.
+    assert report["worker_health"]["builds_started"] >= 6
+
+
+def test_loadgen_spawns_own_worker(tmp_path):
+    """With no --socket, loadgen spawns an in-process worker for the
+    run (the zero-setup smoke path CI uses) and still reports."""
+    report_path = tmp_path / "spawned.json"
+    args = _loadgen_args([
+        "--concurrency", "2", "--builds", "2",
+        "--files", "3", "--file-kb", "1",
+        "--max-concurrent-builds", "1",
+        "--poll-interval", "0.05",
+        "--report", str(report_path),
+        "--work-dir", str(tmp_path / "work"),
+    ])
+    assert loadgen.run(args) == 0
+    report = json.loads(report_path.read_text())
+    assert report["builds"] == 2 and report["failures"] == 0
+    assert report["config"]["max_concurrent_builds"] == 1
+
+
+def test_make_template_and_edit_churn(tmp_path):
+    loadgen._make_template(str(tmp_path), 0, files=5, file_kb=1)
+    src = tmp_path / "src"
+    assert len(list(src.iterdir())) == 5
+    assert (tmp_path / "base" / "vendor.txt").exists()
+    dockerfile = (tmp_path / "Dockerfile").read_text()
+    assert "COPY base/ /base/" in dockerfile
+    before = {p.name: p.read_text() for p in src.iterdir()}
+    edited = loadgen._edit_files(str(tmp_path), 0.4, "s1")
+    assert edited == 2  # 40% of 5
+    after = {p.name: p.read_text() for p in src.iterdir()}
+    changed = [n for n in before if before[n] != after[n]]
+    assert len(changed) == 2
+    # base/ is never churned.
+    assert (tmp_path / "base" / "vendor.txt").read_text().startswith(
+        "# template 0")
+    assert loadgen._edit_files(str(tmp_path), 0.0, "s2") == 0
+
+
+def test_occupancy_parse():
+    text = (
+        '# TYPE makisu_hash_batch_occupancy histogram\n'
+        'makisu_hash_batch_occupancy_bucket{bucket="16384",le="0.5"}'
+        ' 3\n'
+        'makisu_hash_batch_occupancy_sum{bucket="16384"} 1.5\n'
+        'makisu_hash_batch_occupancy_count{bucket="16384"} 3\n'
+        'makisu_hash_batch_occupancy_sum{bucket="262144"} 0.5\n'
+        'makisu_hash_batch_occupancy_count{bucket="262144"} 1\n')
+    occ = loadgen._occupancy_from_metrics(text)
+    assert occ == {"batches": 4, "mean_occupancy": 0.5}
+    assert loadgen._occupancy_from_metrics("") is None
+
+
+def test_render_report_digest():
+    report = {
+        "schema": loadgen.LOADGEN_SCHEMA,
+        "builds": 4, "failures": 1, "wall_seconds": 10.0,
+        "throughput_builds_per_s": 0.4,
+        "latency_seconds": {"count": 3, "p50": 1.0, "p90": 2.0,
+                            "p99": 2.0, "max": 2.0},
+        "queue_wait_seconds": {"count": 3, "p50": 0.5, "p90": 1.0,
+                               "p99": 1.0, "max": 1.0},
+        "exec_seconds": {"count": 3, "p50": 0.5, "p90": 1.0,
+                         "p99": 1.0, "max": 1.0},
+        "queue_wait_share": 0.5,
+        "cold_latency_seconds": {"count": 1, "p50": 2.0, "p90": 2.0,
+                                 "p99": 2.0, "max": 2.0},
+        "warm_latency_seconds": {"count": 2, "p50": 1.0, "p90": 1.0,
+                                 "p99": 1.0, "max": 1.0},
+        "tenant_latency_seconds": {
+            "a": {"count": 2, "p50": 1.0, "p90": 2.0, "p99": 2.0,
+                  "max": 2.0},
+            "b": {"count": 1, "p50": 1.0, "p90": 1.0, "p99": 1.0,
+                  "max": 1.0}},
+        "tenant_fairness_p99_ratio": 2.0,
+        "hash_batch_occupancy": {"batches": 7,
+                                 "mean_occupancy": 0.25},
+        "cache_trajectory": [
+            {"cache_hit_ratio": 0.0}, {"cache_hit_ratio": 0.5}],
+        "peak_inflight": 3, "peak_queue_depth": 2,
+    }
+    text = loadgen.render_report(report)
+    assert "4 builds (1 failed)" in text
+    assert "p99   2.000s" in text
+    assert "share 50.0%" in text
+    assert "fairness (max/min tenant p99): 2.00" in text
+    assert "occupancy: 25.0% over 7 batches" in text
+    assert "0% → 50%" in text
+    assert "peak in-flight 3, peak queue depth 2" in text
+
+
+def test_loadgen_worker_not_reachable(tmp_path):
+    args = _loadgen_args([
+        "--socket", str(tmp_path / "nope.sock"),
+        "--concurrency", "1", "--builds", "1",
+        "--ready-timeout", "0.2",
+        "--work-dir", str(tmp_path / "work"),
+    ])
+    assert loadgen.run(args) == 1
+
+
+def test_top_renders_live_worker(tmp_path, capsys):
+    """`makisu-tpu top --once` against a live worker prints the queue
+    header and the finished build's row."""
+    server = WorkerServer(str(tmp_path / "top.sock"))
+    server.serve_background()
+    try:
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text("FROM scratch\nCOPY f /f\n")
+        (ctx / "f").write_text("x")
+        (tmp_path / "root").mkdir()
+        client = WorkerClient(server.socket_path)
+        assert client.build([
+            "--log-level", "error", "build", str(ctx),
+            "-t", "top/t:1", "--storage", str(tmp_path / "s"),
+            "--root", str(tmp_path / "root")], tenant="ops") == 0
+        assert cli.main(["top", "--socket", server.socket_path,
+                         "--once"]) == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+    out = capsys.readouterr().out
+    assert "makisu-tpu top" in out
+    assert "queue wait p50/p99" in out
+    assert "(no builds in flight)" in out
+    assert "ops" in out and "top/t:1" in out
+
+
+def test_top_unreachable_socket(tmp_path, capsys):
+    assert cli.main(["top", "--socket", str(tmp_path / "no.sock"),
+                     "--once"]) == 1
+    assert "not reachable" in capsys.readouterr().out
+
+
+def test_render_top_canned():
+    from makisu_tpu.tools import top
+    health = {
+        "uptime_seconds": 4000.0, "active_builds": 1,
+        "builds_succeeded": 5, "builds_failed": 1,
+        "last_progress_seconds": 0.4,
+        "transfer_inflight_bytes": 2 * 1024 * 1024,
+        "queue": {"depth": 2, "max_concurrent_builds": 2,
+                  "wait_seconds": {"count": 6, "p50": 0.1,
+                                   "p99": 1.5},
+                  "latency_seconds": {"count": 6, "p50": 3.0,
+                                      "p99": 9.0}},
+    }
+    builds = {
+        "queue_depth": 2, "max_concurrent_builds": 2,
+        "inflight": [
+            {"id": 7, "tenant": "acme", "state": "running",
+             "phase": "hash", "queue_wait_seconds": 0.2,
+             "age_seconds": 12.0, "progress_age_seconds": 0.1,
+             "cache": {"kv_consults": 4, "kv_hits": 3,
+                       "kv_hit_ratio": 0.75},
+             "tag": "acme/app:dev"},
+            {"id": 8, "tenant": "", "state": "queued",
+             "phase": "", "queue_wait_seconds": 5.0,
+             "age_seconds": 5.0, "progress_age_seconds": 5.0,
+             "cache": {}, "tag": "x/y:1"},
+        ],
+        "recent": [
+            {"id": 6, "tenant": "acme", "exit_code": 0,
+             "queue_wait_seconds": 0.0, "elapsed_seconds": 2.5,
+             "tag": "acme/app:dev"}],
+    }
+    frame = top.render_top(health, builds, "/run/w.sock")
+    assert "queued 2/cap 2" in frame
+    assert "1h06m" in frame            # uptime formatting
+    assert "running" in frame and "queued" in frame
+    assert "hash" in frame and "75%" in frame
+    assert "2.0MiB" in frame           # transfer in-flight
+    assert "recent:" in frame and "ok" in frame

@@ -1,0 +1,307 @@
+"""Worker admission-queue telemetry: FIFO admission, queue depth/wait
+metrics under a saturated worker, the /builds endpoint, and per-tenant
+latency labels."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from makisu_tpu.utils import metrics
+from makisu_tpu.worker import WorkerClient, WorkerServer
+from makisu_tpu.worker.server import _AdmissionQueue
+
+
+@pytest.fixture
+def capped_worker(tmp_path):
+    """A worker that executes ONE build at a time; arrivals beyond it
+    wait in the FIFO admission queue."""
+    server = WorkerServer(str(tmp_path / "worker.sock"),
+                          max_concurrent_builds=1)
+    thread = server.serve_background()
+    yield server
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def _make_ctx(tmp_path, name: str):
+    ctx = tmp_path / name
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text("FROM scratch\nCOPY f /f\n")
+    (ctx / "f").write_text(f"payload-{name}")
+    (tmp_path / f"{name}-root").mkdir()
+    return ctx
+
+
+def _build_argv(tmp_path, ctx, name: str) -> list:
+    return ["--log-level", "error", "build", str(ctx),
+            "-t", f"queue/{name}:1",
+            "--storage", str(tmp_path / f"{name}-storage"),
+            "--root", str(tmp_path / f"{ctx.name}-root")]
+
+
+# -- _AdmissionQueue unit behavior -----------------------------------------
+
+
+def test_admission_fifo_order():
+    """Admission past the cap is strictly arrival order: the released
+    slot transfers to the OLDEST waiter, never a newer one."""
+    q = _AdmissionQueue(1)
+    assert q.acquire() == 0.0  # slot taken by the test
+    order = []
+    started = []
+
+    def waiter(i):
+        started.append(i)
+        q.acquire()
+        order.append(i)
+        time.sleep(0.02)
+        q.release()
+
+    threads = []
+    for i in range(4):
+        t = threading.Thread(target=waiter, args=(i,))
+        t.start()
+        threads.append(t)
+        # Arrival order must be deterministic for the assertion.
+        deadline = time.monotonic() + 5
+        while q.depth() < i + 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+    q.release()  # hand the slot to waiter 0
+    for t in threads:
+        t.join(timeout=10)
+    assert order == [0, 1, 2, 3]
+    assert q.depth() == 0
+
+
+def test_admission_unlimited_never_blocks():
+    q = _AdmissionQueue(0)
+    t0 = time.monotonic()
+    for _ in range(100):
+        assert q.acquire() == 0.0
+    q.release()
+    assert time.monotonic() - t0 < 1.0
+    assert q.depth() == 0
+
+
+def test_admission_wait_is_measured():
+    q = _AdmissionQueue(1)
+    q.acquire()
+    waited = {}
+
+    def second():
+        waited["s"] = q.acquire()
+        q.release()
+
+    t = threading.Thread(target=second)
+    t.start()
+    deadline = time.monotonic() + 5
+    while q.depth() < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    time.sleep(0.1)
+    q.release()
+    t.join(timeout=5)
+    assert waited["s"] >= 0.1
+
+
+# -- saturated-worker integration ------------------------------------------
+
+
+def test_saturated_worker_queue_metrics(tmp_path, capped_worker):
+    """With the single execution slot held, a submitted build is
+    visible as QUEUED (depth gauge, /builds state, /healthz queue
+    section) and, once the slot frees, completes with a measured
+    queue wait that lands in the histograms and tenant rings."""
+    ctx = _make_ctx(tmp_path, "qctx")
+    # Deterministically saturate the worker: occupy the only slot.
+    capped_worker._admission.acquire()
+    client = WorkerClient(capped_worker.socket_path)
+    done = {}
+
+    def submit():
+        done["code"] = client.build(
+            _build_argv(tmp_path, ctx, "queued"), tenant="acme")
+
+    t = threading.Thread(target=submit)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while capped_worker._admission.depth() < 1:
+            assert time.monotonic() < deadline, \
+                "build never reached the admission queue"
+            time.sleep(0.01)
+        # The queued build is visible everywhere a scheduler looks:
+        assert metrics.global_registry().gauge_value(
+            "makisu_worker_queue_depth") == 1
+        probe = WorkerClient(capped_worker.socket_path)
+        builds = probe.builds()
+        assert builds.queue_depth == 1
+        assert builds.max_concurrent_builds == 1
+        [queued] = builds.inflight
+        assert queued.state == "queued"
+        assert queued.tenant == "acme"
+        assert queued.queue_wait_seconds > 0  # still growing
+        health = probe.healthz()
+        assert health.queue_depth == 1
+        assert health.max_concurrent_builds == 1
+        # Queued (pre-admission) builds are not "active" executors.
+        assert health.active_builds == 0
+    finally:
+        capped_worker._admission.release()
+        t.join(timeout=60)
+    assert done["code"] == 0
+    # The terminal frame carries the admission split as data.
+    assert client.last_build["tenant"] == "acme"
+    assert client.last_build["queue_wait_seconds"] > 0
+    assert (client.last_build["elapsed_seconds"]
+            >= client.last_build["queue_wait_seconds"])
+
+    probe = WorkerClient(capped_worker.socket_path)
+    health = probe.healthz()
+    assert health.queue_depth == 0
+    assert health.queue_wait.count == 1
+    assert health.queue_wait.p50 > 0
+    assert health.build_latency.count == 1
+    assert health.build_latency.p50 >= health.queue_wait.p50
+    assert health.tenant_latency["acme"].count == 1
+    # The finished build landed in /builds "recent" with its record.
+    builds = probe.builds()
+    assert builds.queue_depth == 0 and not builds.inflight
+    [recent] = [b for b in builds.recent if b.tenant == "acme"]
+    assert recent.state == "finished"
+    assert recent.exit_code == 0
+    assert recent.queue_wait_seconds > 0
+    assert len(recent.trace_id) == 32  # from the build_start event
+    # Prometheus histograms carry the per-tenant series.
+    text = probe.metrics()
+    assert 'makisu_build_queue_wait_seconds_bucket' in text
+    assert 'tenant="acme"' in text
+    assert 'makisu_build_latency_seconds_sum{tenant="acme"}' in text
+    assert "makisu_worker_queue_depth 0" in text
+
+
+def test_unsaturated_build_records_zero_wait(tmp_path, capped_worker):
+    ctx = _make_ctx(tmp_path, "fctx")
+    client = WorkerClient(capped_worker.socket_path)
+    code = client.build(_build_argv(tmp_path, ctx, "fast"))
+    assert code == 0
+    assert client.last_build["queue_wait_seconds"] == 0.0
+    assert client.last_build["tenant"] == ""
+    health = client.healthz()
+    assert health.queue_wait.count == 1
+    assert health.queue_wait.p50 == 0.0
+
+
+def test_builds_record_phase_and_cache(tmp_path, capped_worker):
+    """The /builds record is fed by the build's own event stream:
+    trace id, a phase classification, and cache economics from
+    cache_decision events."""
+    ctx = _make_ctx(tmp_path, "pctx")
+    client = WorkerClient(capped_worker.socket_path)
+    argv = _build_argv(tmp_path, ctx, "phase")
+    argv += ["--hasher", "tpu"]
+    assert client.build(argv) == 0
+    assert client.build(argv) == 0  # warm: KV hit
+    recent = WorkerClient(capped_worker.socket_path).builds().recent
+    warm = recent[0]  # newest first
+    assert warm.phase  # at least one span classified
+    cache = warm.get("cache", {})
+    assert cache["kv_consults"] >= 1
+    assert cache["kv_hits"] >= 1  # the warm build hit
+    assert warm.cache_hit_ratio > 0
+
+
+def test_tenant_from_object_body(tmp_path, capped_worker):
+    """POST /build accepts ``{"argv": [...], "tenant": "..."}`` and
+    labels the build with the body's tenant when no header names
+    one."""
+    import http.client
+    import socket as socket_mod
+
+    ctx = _make_ctx(tmp_path, "octx")
+
+    class _Conn(http.client.HTTPConnection):
+        def connect(self):
+            sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                     socket_mod.SOCK_STREAM)
+            sock.connect(capped_worker.socket_path)
+            self.sock = sock
+
+    conn = _Conn("localhost")
+    body = json.dumps({
+        "argv": _build_argv(tmp_path, ctx, "objbody"),
+        "tenant": "body-tenant",
+    })
+    conn.request("POST", "/build", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    payload = resp.read().decode()
+    conn.close()
+    assert '"exit_code": 0' in payload
+    assert '"tenant": "body-tenant"' in payload
+    recent = WorkerClient(capped_worker.socket_path).builds().recent
+    assert recent[0].tenant == "body-tenant"
+
+
+def test_bad_body_rejected(capped_worker):
+    import http.client
+    import socket as socket_mod
+
+    class _Conn(http.client.HTTPConnection):
+        def connect(self):
+            sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                     socket_mod.SOCK_STREAM)
+            sock.connect(capped_worker.socket_path)
+            self.sock = sock
+
+    for body in ('{"argv": "not-a-list"}', '{"argv": [1, 2]}', '42'):
+        conn = _Conn("localhost")
+        conn.request("POST", "/build", body=body,
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.close()
+    assert WorkerClient(capped_worker.socket_path).ready()
+
+
+def test_env_cap_configures_admission(tmp_path, monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_MAX_CONCURRENT_BUILDS", "3")
+    server = WorkerServer(str(tmp_path / "env.sock"))
+    try:
+        assert server.max_concurrent_builds == 3
+        assert server._admission.limit == 3
+    finally:
+        server.server_close()
+
+
+def test_tenant_label_cardinality_capped(tmp_path):
+    """The tenant string is client-supplied: past the cap, new
+    tenants aggregate under "other" in the latency rings (and the
+    histogram labels), so a buggy client stamping unique strings
+    can't grow a long-lived worker's memory or /metrics cardinality
+    without bound."""
+    from makisu_tpu.worker import server as server_mod
+    server = WorkerServer(str(tmp_path / "cap.sock"))
+    try:
+        for i in range(server_mod._TENANT_LABELS_KEEP + 10):
+            record = server.register_build(["version"], f"tenant-{i}")
+            record.start_running(0.0)
+            server._retire_build(record, 0)
+        rings = server._tenant_latency
+        assert len(rings) == server_mod._TENANT_LABELS_KEEP + 1
+        assert server_mod._TENANT_OVERFLOW in rings
+        assert rings[server_mod._TENANT_OVERFLOW].stats()["count"] \
+            == 10
+        # /builds keeps the exact string even for capped tenants.
+        assert server.builds()["recent"][0]["tenant"] == \
+            f"tenant-{server_mod._TENANT_LABELS_KEEP + 9}"
+        # The histograms carry the capped label set too.
+        from makisu_tpu.utils import metrics as metrics_mod
+        text = metrics_mod.render_prometheus()
+        assert f'tenant="{server_mod._TENANT_OVERFLOW}"' in text
+    finally:
+        server.server_close()
