@@ -49,9 +49,16 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # Stage names in child execution order; the parent reports the deepest
-# one whose line it saw. Keep in sync with _child_main.
-_STAGES = ("start", "import", "backend", "tiny", "big", "native",
-           "prod", "ab", "ab_sha")
+# one whose line it saw. Keep in sync with _child_main. "probe" is the
+# phase-resolved backend-init probe (ops/backend.py): its per-phase
+# heartbeat lines stream between "import" and "backend", so a wedge
+# names its PHASE instead of r01–r05's bare "died in: backend".
+_STAGES = ("start", "import", "probe", "backend", "tiny", "big",
+           "native", "prod", "ab", "ab_sha")
+
+# Stages meaning "backend init never completed" — the wedge signature
+# the fail-fast retry policy keys on.
+_PRE_BACKEND_STAGES = ("none", "start", "import", "probe")
 
 
 def _cpu_baseline_gbps(nbytes: int = 64 * 1024 * 1024) -> float:
@@ -588,12 +595,59 @@ def _child_main() -> int:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     _emit("import", import_secs=round(time.perf_counter() - t0, 2))
 
+    # Backend init runs through the PHASE-RESOLVED probe
+    # (ops/backend.py): each sub-phase (plugin discovery, PJRT client
+    # creation, device enumeration, first compile, first dispatch)
+    # streams a heartbeat line to the parent — fail-fast triggers on
+    # phase-level progress — and the attempt lands in the
+    # benchmarks/device_sessions deviceprobe ledger whether it
+    # succeeds, fails, or wedges (failed sessions are the data the
+    # device-route diagnosis needs).
+    from makisu_tpu.ops import backend as _backend
+    from makisu_tpu.utils import events as _events
+    os.environ.setdefault(
+        "MAKISU_TPU_DEVICE_SESSIONS_DIR",
+        os.path.join(_REPO, "benchmarks", "device_sessions"))
+
+    def _phase_sink(ev: dict) -> None:
+        if ev.get("type") == "device_probe":
+            _emit("probe", probe_phase=ev.get("phase", ""),
+                  probe_status=ev.get("status", ""))
+
+    _events.add_global_sink(_phase_sink)
+    # Bound the probe UNDER the parent's stall window: a wedged init
+    # then concludes inside the child — wedged-phase + stack-sample
+    # ledger record written, verdict line flushed — instead of the
+    # child dying silently under the parent's kill.
+    try:
+        stall = float(os.environ.get(
+            "MAKISU_BENCH_STALL_TIMEOUT", "300") or 300)
+    except ValueError:
+        stall = 300.0
+    os.environ.setdefault("MAKISU_TPU_PROBE_TIMEOUT",
+                          str(max(60.0, 0.85 * stall)))
+    err = _backend.backend_ready(source="bench")
+    snap = _backend.probe_snapshot()
+    if err is not None:
+        _backend.wait_for_probe_record(5.0)  # ledger line lands first
+        _emit("probe", probe_verdict=snap.get("state", "?"),
+              probe_wedged_phase=snap.get("phase", ""),
+              probe_phase_reached=snap.get("phase_reached", ""),
+              probe_samples=snap.get("sample_count", 0),
+              probe_deepest_frame=snap.get("deepest_frame", ""),
+              probe_error=err[:200])
+        return 3
+    _emit("probe", probe_verdict="ok",
+          probe_phases={p["phase"]: p["seconds"]
+                        for p in snap.get("phases", [])})
+
     t0 = time.perf_counter()
-    devices = jax.devices()           # forces backend client init
+    devices = jax.devices()           # instant: the probe initialized it
     backend = jax.default_backend()
     _emit("backend", backend=backend, devices=len(devices),
           device_kind=getattr(devices[0], "device_kind", "?"),
-          init_secs=round(time.perf_counter() - t0, 2))
+          init_secs=round(snap.get("elapsed_seconds",
+                                   time.perf_counter() - t0), 2))
 
     # Tiny shapes first: compiles in seconds even cold, so any working
     # backend yields a device datapoint well inside the budget. (More
@@ -771,6 +825,53 @@ def _run_child(env_overrides: dict[str, str], timeout: float,
     return merged, failure
 
 
+def _parent_wedge_record(result: dict, err: str) -> None:
+    """Append the deviceprobe ledger record on the CHILD's behalf.
+
+    Verified live (2026-08): the axon/libtpu init wedge HOLDS THE GIL
+    through its metadata-retry loop — every Python thread in the child
+    freezes, including the probe watcher, so the in-child wedge record
+    and stack samples can never be captured (this is also why r01–r05's
+    armed watchdogs produced nothing). The child's phase heartbeat
+    lines flush BEFORE the freeze, so the parent knows the wedged
+    phase and writes the record itself. Skipped when the child
+    concluded its own probe (a ``probe_verdict`` line means the
+    in-child record landed)."""
+    if "probe_verdict" in result or not err:
+        return
+    phase = result.get("probe_phase", "")
+    if not phase:
+        return  # probe never started; nothing device-shaped to record
+    try:
+        from makisu_tpu.ops.backend import _platform_key  # noqa: PLC0415
+        from makisu_tpu.utils import deviceprobe
+        os.environ.setdefault(
+            "MAKISU_TPU_DEVICE_SESSIONS_DIR",
+            os.path.join(_REPO, "benchmarks", "device_sessions"))
+        deviceprobe.append_record({
+            "schema": deviceprobe.SCHEMA,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "source": "bench-parent",
+            "platform": os.environ.get("JAX_PLATFORMS", "") or
+                        "(default)",
+            "attachment": {"key": _platform_key(), "vars": []},
+            "verdict": "wedged",
+            "detail": (f"child killed: {err}"[:300]),
+            "timeout_seconds": 0.0,
+            "total_seconds": 0.0,
+            "phase_reached": "",
+            "wedged_phase": (phase
+                             if result.get("probe_status") == "start"
+                             else ""),
+            "phases": [],
+            "samples": [],
+            "gil_held_suspected": True,
+        })
+    except Exception:  # noqa: BLE001 - forensics must not fail bench
+        pass
+
+
 def _device_attempts(budget: float) -> tuple[dict, str, list]:
     """Spread the device budget over several spaced attempts instead of
     one long wait. Both observed wedges (2026-07) hang backend init
@@ -792,14 +893,20 @@ def _device_attempts(budget: float) -> tuple[dict, str, list]:
         if remaining < 90:     # too little left for init + tiny shape
             break
         result, err = _run_child({}, remaining, stall_timeout=stall)
+        if err:
+            # A GIL-holding wedge freezes the child's own ledger path;
+            # the parent records the attempt from the streamed phase
+            # heartbeats (no-op when the child concluded its probe).
+            _parent_wedge_record(result, err)
         attempts.append({
             "stage_reached": result.get("stage_reached", "none"),
+            "probe_phase": result.get("probe_phase", ""),
             **({"error": err[:120]} if err else {}),
         })
         if "gbps" in result or "tiny_gbps" in result:
             break
         if failfast and err and result.get(
-                "stage_reached", "none") in ("none", "start", "import"):
+                "stage_reached", "none") in _PRE_BACKEND_STAGES:
             # Backend init never completed: the tunnel is wedged, and
             # both observed wedge modes (2026-07) hang init FOREVER —
             # retrying the same dead backend burned ~13 minutes of the
@@ -1185,7 +1292,11 @@ def main() -> int:
                   "prod_error", "sha_block_unroll_sweep",
                   "pallas_off_sweep", "device_attempt",
                   "device_attempts", "evidence_path",
-                  "jax_platforms_env", "device_kind"):
+                  "jax_platforms_env", "device_kind",
+                  "probe_verdict", "probe_wedged_phase",
+                  "probe_phase_reached", "probe_samples",
+                  "probe_deepest_frame", "probe_error",
+                  "probe_phases"):
         if extra in result:
             record[extra] = result[extra]
     # The OTHER BASELINE.md target (>=3x warm-cache at 100k files) is
@@ -1241,6 +1352,24 @@ def main() -> int:
         record["history"] = _history_tail()
     except Exception as e:  # noqa: BLE001 - informational section
         record["history"] = {"error": str(e)[:200]}
+    # Device-session ledger tail: every probe attempt this round (and
+    # the rounds before it) as durable deviceprobe.v1 records — the
+    # long-promised benchmarks/device_sessions artifact now records
+    # ATTEMPTS, not just confirmed backends; `makisu-tpu doctor
+    # --device` renders the cross-round diagnosis.
+    try:
+        from makisu_tpu.utils import deviceprobe as _dp
+        sessions = _dp.sessions_dir()  # honors the env override
+        if sessions:
+            shown = (os.path.relpath(sessions, _REPO)
+                     if os.path.abspath(sessions).startswith(_REPO)
+                     else sessions)
+            record["device_sessions"] = {
+                "path": shown,
+                **_dp.tail(path=sessions),
+            }
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["device_sessions"] = {"error": str(e)[:200]}
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))

@@ -172,8 +172,17 @@ class _LaneBatcher:
         self.flush()
         out: list[Chunk] = []
         for digests, meta in self.pending:
+            t0 = time.monotonic()
             host = _backend.sync_bounded(
                 digests, "lane digest readback")  # bounded sync point
+            # Readback-wait per program (timed around the sync only:
+            # dispatch was async at flush and the host kept scanning in
+            # between — flush-to-drain wall time would charge that host
+            # work to the device and poison the per-bucket digests the
+            # shared HashService exports under the same names).
+            _backend.note_device_dispatch(
+                self.cap, self.lanes, len(meta),
+                sum(n for _, n in meta), time.monotonic() - t0)
             for i, (off, n) in enumerate(meta):
                 out.append(Chunk(off, n, host[i].astype(">u4").tobytes()))
         self.pending = []

@@ -127,6 +127,13 @@ class HashService:
                 fut.set_exception(e)
             return
         self.batches += 1
+        # Device execution telemetry: dispatch latency ring + compile
+        # gauge + H2D/padding-waste bytes, per bucket (ops/backend.py
+        # owns the shared accounting so the lane batcher's direct
+        # route exports identical series).
+        _backend.note_device_dispatch(cap, lanes, len(batch),
+                                      int(lengths.sum()),
+                                      time.monotonic() - t0)
         owners = {owner for _, _, owner in batch if owner is not None}
         if len(owners) > 1:
             self.cross_build_batches += 1

@@ -322,11 +322,22 @@ def make_parser() -> argparse.ArgumentParser:
                               "time per phase)")
 
     doctor = sub.add_parser(
-        "doctor", help="diagnose a failure-forensics bundle")
-    doctor.add_argument("bundle",
+        "doctor", help="diagnose a failure-forensics bundle, or the "
+                       "device route (--device)")
+    doctor.add_argument("bundle", nargs="?", default="",
                         help="a diagnostic bundle JSON (written by "
                              "--diag-out, the stall watchdog, or the "
-                             "SIGTERM/SIGUSR1 handlers)")
+                             "SIGTERM/SIGUSR1 handlers); with "
+                             "--device, the deviceprobe ledger file "
+                             "or sessions directory instead")
+    doctor.add_argument("--device", action="store_true",
+                        help="cross-session device-route diagnosis "
+                             "from the makisu-tpu.deviceprobe.v1 "
+                             "ledger: dominant wedge phase/frame, "
+                             "per-attachment verdict history, last "
+                             "healthy window (default ledger: "
+                             "$MAKISU_TPU_DEVICE_SESSIONS_DIR or "
+                             "benchmarks/device_sessions)")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -732,11 +743,32 @@ def cmd_explain(args) -> int:
 def cmd_doctor(args) -> int:
     """Render a diagnostic bundle into a human diagnosis: the stuck
     span, wedged threads, transfer-engine backlog, and the resource
-    trajectory leading up to the capture."""
+    trajectory leading up to the capture. ``--device`` switches to the
+    cross-session device-route diagnosis: every recorded backend-probe
+    attempt (the ``makisu-tpu.deviceprobe.v1`` ledger), its verdict,
+    the dominant wedge phase and sampled frame, and when the route was
+    last healthy."""
     import json as json_mod
 
     from makisu_tpu.utils import flightrecorder
 
+    if args.device:
+        from makisu_tpu.utils import deviceprobe
+        records = deviceprobe.read_records(args.bundle or None)
+        if not records:
+            where = (args.bundle or deviceprobe.sessions_dir()
+                     or "$MAKISU_TPU_DEVICE_SESSIONS_DIR (unset)")
+            raise SystemExit(
+                f"no {deviceprobe.SCHEMA} records found in {where}; "
+                f"probe attempts record there when a device is "
+                f"configured (or when MAKISU_TPU_DEVICE_SESSIONS_DIR "
+                f"is set explicitly)")
+        print(deviceprobe.render_device_doctor(records), end="")
+        return 0
+    if not args.bundle:
+        raise SystemExit(
+            "doctor needs a diagnostic-bundle path (or --device for "
+            "the device-route ledger diagnosis)")
     with open(args.bundle, encoding="utf-8") as f:
         bundle = json_mod.load(f)
     if bundle.get("schema") != flightrecorder.BUNDLE_SCHEMA:

@@ -1,4 +1,4 @@
-"""Bounded, cached JAX backend-readiness probe.
+"""Bounded, cached, phase-resolved JAX backend-readiness probe.
 
 The TPU plugin on tunneled hosts (axon) initializes through a network
 relay that has been observed to go from healthy (~20s init) to wedged
@@ -7,14 +7,48 @@ raises, so the chunker's exception-based degradation
 (chunker/cdc.py "failure discipline") cannot catch it — the first
 ``gear_bitmap`` dispatch would block a build forever.
 
-``backend_ready()`` closes that gap: the first call runs
-``jax.devices()`` in a daemon thread and waits a bounded time; callers
-on the device plane consult it before their first dispatch and degrade
-(whole-layer caching, no chunk fingerprints) when the backend cannot
-come up. The probe result is cached process-wide, so a wedged tunnel
-costs ONE bounded wait per process — and if the stuck init eventually
-completes, later calls see the backend as ready (the probe thread keeps
-running and flips the cached state).
+``backend_ready()`` closes that gap: the first call runs the probe in
+a daemon thread and waits a bounded time; callers on the device plane
+consult it before their first dispatch and degrade (whole-layer
+caching, no chunk fingerprints) when the backend cannot come up. The
+probe result is cached process-wide, so a wedged tunnel costs ONE
+bounded wait per process — and if the stuck init eventually completes,
+later calls see the backend as ready (the probe thread keeps running
+and flips the cached state).
+
+Observability (the piece every bench round r01–r05 lacked — each died
+with nothing finer than "died in: backend"):
+
+- The probe is PHASE-RESOLVED: the opaque ``jax.devices()`` wait is
+  split into ``PROBE_PHASES`` (plugin/attachment discovery, PJRT
+  client creation, device enumeration, first compile, first dispatch),
+  each a ``metrics.span`` that also emits ``device_probe`` heartbeat
+  events on the build event bus — so a wedge names its phase.
+- A sidecar WATCHER thread samples the probe thread's stack
+  (``sys._current_frames``) on an interval: the known wedge hangs
+  inside a C call where no exception ever fires, so the deepest-Python-
+  frame trajectory ("12 identical samples inside make_c_api_client via
+  xla_bridge.backends") is the only diagnosis available.
+- Every probe attempt — build, worker warm probe, bench child —
+  appends a ``makisu-tpu.deviceprobe.v1`` record (attachment
+  fingerprint, per-phase timings, stack trajectory, verdict) to the
+  device-session ledger (``utils/deviceprobe.py``), which
+  ``makisu-tpu doctor --device`` renders across sessions.
+- Once a backend is up, :func:`note_device_dispatch` aggregates the
+  device execution plane per lane bucket: compile time (first
+  dispatch), dispatch-latency rings, H2D bytes, and padding waste —
+  exported via /metrics and the worker's ``/healthz`` ``device``
+  section (:func:`device_health`).
+
+Known limitation (verified live, 2026-08): the axon/libtpu init wedge
+can HOLD THE GIL through its C-level retry loop — every Python thread
+freezes, watcher included, so neither the bounded wait nor the stack
+sampler can act in-process (this is why r01–r05's armed watchdogs
+produced nothing). The phase heartbeats flush BEFORE the freeze, so a
+supervising parent (bench.py) still learns the wedged phase from the
+stream and writes the ledger record on the child's behalf
+(``bench._parent_wedge_record``). Wedges that park WITHOUT the GIL
+(pure network waits) are fully observable in-process.
 
 The reference has no counterpart (its hashing is host-only,
 lib/builder/step/common.go:35-67); this is accelerator-era failure
@@ -23,8 +57,11 @@ detection in the SURVEY §5 "failure recovery" sense.
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import json
 import os
+import sys
 import tempfile
 import threading
 import time
@@ -57,18 +94,329 @@ _probe_start = 0.0  # monotonic time the probe thread was started
 _timed_out = False  # a full bounded wait already elapsed once
 _grace_spent = False  # the cached-verdict grace already elapsed once
 
+# Probe sub-phases, in execution order. "client_init" is the PJRT
+# C-API client creation — the phase both observed 2026-07 wedges hung
+# in; the compile/dispatch phases exist because a tunnel that
+# initializes can still wedge the first program (distinct failure
+# mode, distinct fix).
+PROBE_PHASES = ("plugin_discovery", "client_init", "device_enumeration",
+                "first_compile", "first_dispatch")
+
+# Trajectory bound: consecutive identical deepest-frames collapse into
+# one counted entry, so even an hours-long wedge stays a handful of
+# entries; distinct-frame churn is trimmed from the front.
+_SAMPLES_KEEP = 64
+_SAMPLE_STACK_DEPTH = 12
+
+
+class _ProbeTracker:
+    """Phase + stack-sample state of this process's one probe attempt.
+    Plain attribute stores and list appends only (GIL-atomic), so the
+    forensics readers — /healthz, flight-recorder bundles from signal
+    handlers — never need a lock the probe path might hold."""
+
+    def __init__(self) -> None:
+        self.source = "build"   # who started the probe (build|worker|bench)
+        self.phases: list[dict] = []   # [{"phase", "seconds", "ok"}]
+        self.current = ""              # phase currently executing
+        self.samples: list[dict] = []  # [{"frame", "count", "stack"}]
+        self.last_beat = 0.0           # monotonic: last phase event/sample
+        self.verdict = ""              # ""|ok|failed|wedged|ok_late|...
+        self.detail = ""
+        # Set once a terminal ledger record (or the wedge record) has
+        # been appended — tests and CI smokes wait on this instead of
+        # polling the filesystem.
+        self.recorded = threading.Event()
+
+    def phase_reached(self) -> str:
+        """The last phase that COMPLETED ok ("" if none did)."""
+        reached = ""
+        for p in self.phases:
+            if p.get("ok"):
+                reached = p["phase"]
+        return reached
+
+
+_tracker = _ProbeTracker()
+
+
+@contextlib.contextmanager
+def _phase(name: str):
+    """One probe sub-phase: a span on the global registry (visible in
+    flight-recorder bundles as an open span while wedged) plus
+    ``device_probe`` start/done heartbeat events on the event bus (the
+    bench child streams these to its parent for phase-level
+    fail-fast)."""
+    from makisu_tpu.utils import events, metrics
+    tracker = _tracker
+    tracker.current = name
+    tracker.last_beat = time.monotonic()
+    events.emit("device_probe", phase=name, status="start")
+    t0 = time.monotonic()
+    ok = False
+    try:
+        with metrics.span(f"device_probe.{name}"):
+            yield
+        ok = True
+    finally:
+        dt = time.monotonic() - t0
+        tracker.phases.append({"phase": name,
+                               "seconds": round(dt, 4), "ok": ok})
+        tracker.current = ""
+        tracker.last_beat = time.monotonic()
+        events.emit("device_probe", phase=name,
+                    status="done" if ok else "error",
+                    seconds=round(dt, 4))
+
+
+def _phase_plugin_discovery(ctx: dict) -> None:
+    """Import jax and enumerate PJRT plugin entry points — the
+    attachment-discovery work backend init will consume."""
+    import jax
+    ctx["jax"] = jax
+    # sitecustomize environments preload jax pinned to the device
+    # tunnel; re-assert the caller's platform choice (same dance as
+    # makisu_tpu/ops/__init__.py) so a cpu-directed probe stays cpu.
+    if "JAX_PLATFORMS" in os.environ:
+        try:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001 - backends already initialized
+            pass
+    try:
+        from importlib import metadata
+        ctx["plugins"] = sorted(
+            ep.name for ep in metadata.entry_points(group="jax_plugins"))
+    except Exception:  # noqa: BLE001 - discovery listing is advisory
+        ctx["plugins"] = []
+
+
+def _phase_client_init(ctx: dict) -> None:
+    """PJRT client creation — the observed wedge point: both 2026-07
+    wedges parked here forever inside ``make_c_api_client``."""
+    ctx["devices"] = ctx["jax"].devices()
+
+
+def _phase_device_enumeration(ctx: dict) -> None:
+    jax = ctx["jax"]
+    ctx["backend"] = jax.default_backend()
+    ctx["device_kinds"] = sorted(
+        {str(getattr(d, "device_kind", "?")) for d in ctx["devices"]})
+
+
+def _phase_first_compile(ctx: dict) -> None:
+    """Compile one trivial program ahead of execution (AOT lower +
+    compile) so a compile-service wedge is distinguishable from a
+    dispatch wedge."""
+    import jax.numpy as jnp
+    jax = ctx["jax"]
+    ctx["probe_arg"] = jnp.zeros((8,), jnp.uint8)
+    ctx["compiled"] = jax.jit(
+        lambda x: x + jnp.uint8(1)).lower(ctx["probe_arg"]).compile()
+
+
+def _phase_first_dispatch(ctx: dict) -> None:
+    """Execute the compiled program and block on the readback — the
+    first full host→device→host round trip."""
+    import numpy as np
+    np.asarray(ctx["compiled"](ctx["probe_arg"]))
+
 
 def _probe() -> None:
+    ctx: dict = {}
     try:
-        import jax
-
-        jax.devices()
+        for name in PROBE_PHASES:
+            # globals() lookup at run time: tests monkeypatch
+            # individual phase functions to simulate wedges.
+            fn = globals()["_phase_" + name]
+            with _phase(name):
+                fn(ctx)
         _result[0] = "ok"
         _clear_cached_wedge()
     except Exception as e:  # noqa: BLE001 - init failures become a reason
         _result[0] = f"backend init failed: {e}"
     finally:
         _done.set()
+
+
+def _sample_interval() -> float:
+    """Seconds between probe-thread stack samples
+    (MAKISU_TPU_PROBE_SAMPLE_INTERVAL, default 1s)."""
+    try:
+        return max(float(os.environ.get(
+            "MAKISU_TPU_PROBE_SAMPLE_INTERVAL", "1.0")), 0.01)
+    except ValueError:
+        return 1.0
+
+
+# Frames that are the interpreter's parking lot, not a location:
+# Event/Condition waits. The REAL wedge parks inside a C call (no
+# Python frame below the caller at all); simulated wedges park in
+# threading waits — skipping these names the caller either way.
+_PARKING_FILES = ("threading.py",)
+
+
+def _representative_frame(stack: list[str]) -> str:
+    for entry in stack:
+        if not any(f"({name}:" in entry for name in _PARKING_FILES):
+            return entry
+    return stack[0]
+
+
+def _sample_probe_stack(tracker: _ProbeTracker, ident) -> None:
+    """One stack sample of the probe thread: record the deepest
+    meaningful Python frame (innermost first); consecutive identical
+    frames collapse into a counted entry — "N identical samples" IS
+    the wedge signature."""
+    if ident is None:
+        return
+    frame = sys._current_frames().get(ident)
+    if frame is None:
+        return
+    stack: list[str] = []
+    f = frame
+    while f is not None and len(stack) < _SAMPLE_STACK_DEPTH:
+        code = f.f_code
+        stack.append(f"{code.co_name} "
+                     f"({os.path.basename(code.co_filename)}:"
+                     f"{f.f_lineno})")
+        f = f.f_back
+    if not stack:
+        return
+    deepest = _representative_frame(stack)
+    samples = tracker.samples
+    if samples and samples[-1]["frame"] == deepest:
+        samples[-1]["count"] += 1
+    else:
+        if len(samples) >= _SAMPLES_KEEP:
+            del samples[:_SAMPLES_KEEP // 4]
+        samples.append({"frame": deepest, "count": 1, "stack": stack})
+    tracker.last_beat = time.monotonic()
+
+
+def _recording_wanted() -> bool:
+    """Whether probe attempts should append to the device-session
+    ledger. Explicit ``MAKISU_TPU_DEVICE_SESSIONS_DIR`` always decides
+    (empty value = off); otherwise record exactly when a device is
+    configured for this process — the same signal the warm-probe gate
+    uses — so plain CPU test runs never litter the repo's ledger while
+    every real device attempt (the data we need) is kept."""
+    from makisu_tpu.utils import deviceprobe
+    if os.environ.get("MAKISU_TPU_DEVICE_SESSIONS_DIR") is not None:
+        return deviceprobe.sessions_dir() is not None
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        return platforms.lower() != "cpu"
+    return any(k.startswith(ATTACHMENT_ENV_PREFIXES)
+               and k not in ATTACHMENT_ENV_EXCLUDE
+               for k in os.environ)
+
+
+def _record_attempt(tracker: _ProbeTracker, verdict: str, detail: str,
+                    timeout: float, probe_start: float) -> None:
+    """Append one ``makisu-tpu.deviceprobe.v1`` record for this probe
+    attempt. Never raises — the ledger is forensics, not control
+    flow."""
+    try:
+        if not _recording_wanted():
+            return
+        from makisu_tpu.utils import deviceprobe
+        record = {
+            "schema": deviceprobe.SCHEMA,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "source": tracker.source,
+            "platform": os.environ.get("JAX_PLATFORMS", "") or
+                        "(default)",
+            "attachment": {
+                # Hashed key (raw endpoint values must not land in a
+                # shared artifact) + the var NAMES present, so a reader
+                # can tell two attachments apart and knows what to dump.
+                "key": _platform_key(),
+                "vars": sorted(
+                    k for k in os.environ
+                    if k.startswith(ATTACHMENT_ENV_PREFIXES)
+                    and k not in ATTACHMENT_ENV_EXCLUDE),
+            },
+            "verdict": verdict,
+            "detail": (detail or "")[:300],
+            "timeout_seconds": round(timeout, 1),
+            "total_seconds": round(time.monotonic() - probe_start, 3),
+            "phase_reached": tracker.phase_reached(),
+            "wedged_phase": (tracker.current
+                             if verdict == "wedged" else ""),
+            "phases": [dict(p) for p in tracker.phases],
+            "samples": [dict(s) for s in tracker.samples],
+        }
+        if deviceprobe.append_record(record) is not None:
+            tracker.recorded.set()
+    except Exception:  # noqa: BLE001 - ledger must never fail the probe
+        pass
+
+
+def _watch(probe_thread: threading.Thread, timeout: float,
+           done: threading.Event, tracker: _ProbeTracker,
+           probe_start: float) -> None:
+    """Sidecar watcher: samples the probe thread's stack on an
+    interval; when the bounded budget elapses without completion it
+    appends the WEDGED ledger record (phase + trajectory — the
+    diagnosis no exception path can produce, because the wedge parks
+    inside a C call), then keeps sampling so a late completion still
+    leaves an ``ok_late``/``failed_late`` record (tunnel-revival
+    evidence)."""
+    from makisu_tpu.utils import events
+    # This thread's own activity must not stamp the build-progress
+    # clock it would otherwise keep fresh through a genuine wedge.
+    events.suppress_progress_stamps()
+    interval = _sample_interval()
+    wedge_written = False
+    while not done.wait(interval):
+        try:
+            _sample_probe_stack(tracker, probe_thread.ident)
+            elapsed = time.monotonic() - probe_start
+            if not wedge_written and timeout > 0 and elapsed >= timeout:
+                wedge_written = True
+                tracker.verdict = "wedged"
+                tracker.detail = (
+                    f"backend init did not complete within "
+                    f"{timeout:.0f}s (wedged in "
+                    f"{tracker.current or '?'})")
+                _record_attempt(tracker, "wedged", tracker.detail,
+                                timeout, probe_start)
+                events.emit("device_probe", status="wedged",
+                            phase=tracker.current,
+                            elapsed=round(elapsed, 1))
+        except Exception:  # noqa: BLE001 - watcher must never die early
+            pass
+    verdict = "ok" if _result[0] == "ok" else "failed"
+    if wedge_written:
+        verdict += "_late"
+    tracker.verdict = verdict
+    tracker.detail = "" if _result[0] == "ok" else str(_result[0] or "")
+    _record_attempt(tracker, verdict, tracker.detail, timeout,
+                    probe_start)
+    tracker.recorded.set()  # terminal — even when recording is gated off
+
+
+def wait_for_probe_record(timeout: float = 5.0) -> bool:
+    """Block until this process's probe attempt has reached a recorded
+    verdict (ledger appended, or recording gated off after
+    completion). CI smokes and tests use this instead of polling."""
+    return _tracker.recorded.wait(timeout)
+
+
+def _reset_probe_state_for_tests() -> None:
+    """Fresh probe state (tests only): the module caches one probe per
+    process by design."""
+    global _done, _result, _started, _probe_start, _timed_out, \
+        _grace_spent, _tracker
+    _done = threading.Event()
+    _result = [None]
+    _started = False
+    _probe_start = 0.0
+    _timed_out = False
+    _grace_spent = False
+    _tracker = _ProbeTracker()
 
 
 def init_timeout() -> float:
@@ -252,7 +600,8 @@ def sync_bounded(x, what: str, timeout: float | None = None):
     return result["v"]
 
 
-def backend_ready(timeout: float | None = None) -> str | None:
+def backend_ready(timeout: float | None = None,
+                  source: str = "build") -> str | None:
     """Block (bounded) until the default JAX backend is initialized.
 
     Returns None when the backend is ready, else a failure summary.
@@ -264,13 +613,16 @@ def backend_ready(timeout: float | None = None) -> str | None:
     but the caller gets control back, the verdict is shared with other
     processes (see the wedge cache above), and every later call
     re-checks instantly (and picks up a late success).
+
+    ``source`` labels the deviceprobe ledger record when THIS call is
+    the one that starts the probe (build|worker|bench).
     """
     global _timed_out
     if timeout is None:
         timeout = init_timeout()
     if timeout <= 0:
         return None  # guard disabled: behave as before (block natively)
-    warm_probe()
+    warm_probe(source=source)
     if _done.is_set():
         return None if _result[0] == "ok" else _result[0]
     if _timed_out:
@@ -309,17 +661,171 @@ def backend_ready(timeout: float | None = None) -> str | None:
     return detail
 
 
-def warm_probe() -> None:
+def warm_probe(source: str = "build") -> None:
     """Start the background readiness probe without waiting (worker
     startup; also the first step of every ``backend_ready`` call): by
     the time the first build's ChunkSession consults
     ``backend_ready()``, a healthy backend has usually finished
     initializing and a wedged one charges the build only the remainder
-    of the budget — not a fresh full wait."""
+    of the budget — not a fresh full wait.
+
+    Alongside the probe thread a watcher thread starts: stack samples
+    on an interval, the wedged-verdict ledger record at budget expiry,
+    the terminal record on completion (see :func:`_watch`)."""
     global _started, _probe_start
     with _lock:
         if not _started:
             _started = True
+            _tracker.source = source
             _probe_start = time.monotonic()
-            threading.Thread(target=_probe, daemon=True,
-                             name="jax-backend-probe").start()
+            t = threading.Thread(target=_probe, daemon=True,
+                                 name="jax-backend-probe")
+            t.start()
+            threading.Thread(
+                target=_watch,
+                args=(t, init_timeout(), _done, _tracker, _probe_start),
+                daemon=True, name="jax-probe-watch").start()
+
+
+# -- probe introspection (healthz, history, forensics) ---------------------
+
+
+def probe_snapshot() -> dict:
+    """JSON-ready state of this process's probe attempt. Lock-free by
+    construction (tracker fields are GIL-atomic stores), so the flight
+    recorder can call it from a signal handler.
+
+    ``state``: ``disabled`` (guard off) | ``absent`` (never started) |
+    ``pending`` | ``ok`` | ``failed`` | ``wedged`` (budget elapsed,
+    still parked)."""
+    from makisu_tpu.utils import metrics
+    timeout = init_timeout()
+    if timeout <= 0:
+        state = "disabled"
+    elif not _started:
+        state = "absent"
+    elif _done.is_set():
+        state = "ok" if _result[0] == "ok" else "failed"
+    elif time.monotonic() - _probe_start >= timeout:
+        state = "wedged"
+    else:
+        state = "pending"
+    tracker = _tracker
+    samples = [dict(s) for s in
+               metrics.snapshot_concurrent(tracker.samples)]
+    out: dict = {
+        "state": state,
+        "phase": tracker.current,
+        "phase_reached": tracker.phase_reached(),
+        "phases": [dict(p) for p in
+                   metrics.snapshot_concurrent(tracker.phases)],
+        "samples": samples,
+        "sample_count": sum(int(s.get("count", 0)) for s in samples),
+    }
+    if _started:
+        out["source"] = tracker.source
+        out["elapsed_seconds"] = round(
+            time.monotonic() - _probe_start, 3)
+        if tracker.last_beat:
+            out["heartbeat_age_seconds"] = round(
+                time.monotonic() - tracker.last_beat, 3)
+    if samples:
+        out["deepest_frame"] = samples[-1]["frame"]
+    detail = tracker.detail or (
+        _result[0] if _done.is_set() and _result[0] != "ok" else "")
+    if detail:
+        out["detail"] = str(detail)[:300]
+    return out
+
+
+def probe_label() -> str:
+    """One-word device-route label for history records
+    (``utils/history.py``): ``ok`` | ``wedged`` | ``failed`` |
+    ``pending`` | ``absent`` | ``disabled``."""
+    return probe_snapshot()["state"]
+
+
+# -- device execution telemetry --------------------------------------------
+#
+# Once a backend IS up, the questions change: how long did each bucket's
+# program take to compile, what does a dispatch round trip cost, how
+# many bytes cross the PCIe/tunnel per program, and how much of each
+# padded lane buffer is waste (the padding the ragged-batch work —
+# ROADMAP item 3, arxiv 2604.15464 — exists to remove). One helper
+# aggregates all of it so the HashService and the lane batcher can't
+# drift apart.
+
+_DISPATCH_RING_KEEP = 256
+
+_dispatch_lock = threading.Lock()
+_dispatch_rings: dict[int, "collections.deque[float]"] = {}
+_compiled_buckets: set[int] = set()
+
+
+def note_device_dispatch(bucket: int, lanes: int, filled: int,
+                         real_bytes: int, seconds: float) -> None:
+    """Record one dispatched device program for lane bucket ``bucket``
+    (its byte capacity): ``lanes`` total lanes shipped, ``filled`` of
+    them carrying real chunks totalling ``real_bytes``, the round trip
+    taking ``seconds`` (dispatch → readback complete).
+
+    Exports, per bucket: ``makisu_device_dispatch_seconds`` histogram,
+    ``makisu_device_compile_seconds`` gauge (the first dispatch of a
+    bucket's program pays its XLA compile; later dispatches reuse it),
+    ``makisu_device_h2d_bytes_total`` (the full padded buffer ships),
+    and ``makisu_device_padding_waste_bytes_total`` (padded−real bytes
+    across the FILLED lanes — empty lanes are the occupancy
+    histogram's story). A bounded per-bucket latency ring backs the
+    exact p50/p99 the ``/healthz`` ``device`` section serves."""
+    from makisu_tpu.utils import metrics
+    with _dispatch_lock:
+        ring = _dispatch_rings.get(bucket)
+        if ring is None:
+            ring = _dispatch_rings[bucket] = collections.deque(
+                maxlen=_DISPATCH_RING_KEEP)
+        first = bucket not in _compiled_buckets
+        if first:
+            _compiled_buckets.add(bucket)
+        ring.append(seconds)
+    if first:
+        metrics.gauge_set(metrics.DEVICE_COMPILE_SECONDS, seconds,
+                          bucket=bucket)
+    metrics.observe(metrics.DEVICE_DISPATCH_SECONDS, seconds,
+                    bucket=bucket)
+    metrics.counter_add(metrics.DEVICE_H2D_BYTES, lanes * bucket,
+                        bucket=bucket)
+    metrics.counter_add(metrics.DEVICE_PADDING_WASTE,
+                        max(filled * bucket - real_bytes, 0),
+                        bucket=bucket)
+
+
+def dispatch_stats() -> dict:
+    """Exact per-bucket dispatch-latency percentiles over the recent
+    ring (the ``/healthz`` device section's latency digest)."""
+    from makisu_tpu.utils import metrics
+    with _dispatch_lock:
+        rings = {b: list(r) for b, r in _dispatch_rings.items()}
+    return {str(b): metrics.percentile_stats(v)
+            for b, v in sorted(rings.items())}
+
+
+def device_health() -> dict:
+    """The worker ``/healthz`` ``device`` section: probe state (phase,
+    heartbeat age, deepest sampled frame) + the execution plane's
+    per-bucket dispatch digests and byte totals."""
+    from makisu_tpu.utils import metrics
+    snap = probe_snapshot()
+    probe = {"state": snap["state"]}
+    for key in ("phase", "phase_reached", "sample_count", "source",
+                "elapsed_seconds", "heartbeat_age_seconds",
+                "deepest_frame", "detail"):
+        if snap.get(key) not in (None, "", 0) or key == "sample_count":
+            probe[key] = snap.get(key)
+    g = metrics.global_registry()
+    return {
+        "probe": probe,
+        "dispatch_seconds": dispatch_stats(),
+        "h2d_bytes": int(g.counter_total(metrics.DEVICE_H2D_BYTES)),
+        "padding_waste_bytes": int(
+            g.counter_total(metrics.DEVICE_PADDING_WASTE)),
+    }

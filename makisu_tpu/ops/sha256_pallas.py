@@ -162,9 +162,13 @@ def _device_parity_ok(lanes: int, cap: int) -> bool:
     key = (lanes, cap)
     if key not in _parity_ok:
         import hashlib
+        import time as _time
 
         from makisu_tpu.ops import backend as _backend
+        from makisu_tpu.utils import events as _events
+        from makisu_tpu.utils import metrics as _metrics
 
+        _t0 = _time.monotonic()
         rng = np.random.default_rng(0xEC0 ^ lanes ^ cap)
         data = rng.integers(0, 256, size=(lanes, cap), dtype=np.uint8)
         # SHA-256 padding needs 9 spare bytes to stay in-block; edge
@@ -191,6 +195,20 @@ def _device_parity_ok(lanes: int, cap: int) -> bool:
         except Exception as e:  # noqa: BLE001 - kernel plane
             mark_broken(e)
             _parity_ok[key] = False
+        # Device-route observability: the per-shape parity probe is the
+        # kernel's own "first compile + first dispatch" — its cost and
+        # verdict were previously invisible. One gauge per bucket shape
+        # + a device_probe heartbeat on the event bus (same stream the
+        # init phases ride), so a bench child's parent sees kernel
+        # probing as progress, not silence.
+        probe_s = _time.monotonic() - _t0
+        _metrics.gauge_set("makisu_device_parity_probe_seconds",
+                           probe_s, bucket=cap,
+                           result="ok" if _parity_ok[key] else "failed")
+        _events.emit("device_probe", phase="sha_parity_probe",
+                     status="done" if _parity_ok[key] else "error",
+                     seconds=round(probe_s, 4), bucket=cap,
+                     lanes=lanes)
     return _parity_ok[key]
 
 

@@ -106,6 +106,20 @@ def _transfer_state() -> dict | None:
     return engine.snapshot() if engine is not None else None
 
 
+def _device_probe_state() -> dict | None:
+    """The backend probe's phase/sample snapshot, or None when the
+    device plane was never touched. Lock-free underneath
+    (``ops/backend.py`` tracker stores are GIL-atomic), so safe from
+    signal context; the ops package is only consulted when something
+    already imported it — a bundle must not pay a jax import."""
+    if "makisu_tpu.ops.backend" not in sys.modules:
+        return None
+    try:
+        return sys.modules["makisu_tpu.ops.backend"].probe_snapshot()
+    except Exception:  # noqa: BLE001 - forensics never fails the dump
+        return None
+
+
 def _metrics_snapshot(reg: "metrics.MetricsRegistry") -> dict | None:
     """``reg.report()`` guarded for signal context: if the interrupted
     main thread holds the registry lock the probe times out and the
@@ -232,6 +246,7 @@ class FlightRecorder:
             "threads": thread_stacks(),
             "transfer": _transfer_state(),
             "resources": resources.trajectory(),
+            "device_probe": _device_probe_state(),
         }
         out["metrics"] = _metrics_snapshot(reg)
         out.update(extra)
@@ -563,6 +578,34 @@ def render_doctor(bundle: dict) -> str:
                 f"completed — suspect a wedged registry connection")
     else:
         lines.append("transfer engine: never used in this process")
+
+    # -- device probe -----------------------------------------------------
+    probe = bundle.get("device_probe") or {}
+    state = probe.get("state", "")
+    if state and state not in ("absent", "disabled"):
+        lines.append("")
+        desc = f"device probe: {state}"
+        if probe.get("phase"):
+            desc += f", in phase '{probe['phase']}'"
+        elif probe.get("phase_reached"):
+            desc += f", reached '{probe['phase_reached']}'"
+        if probe.get("elapsed_seconds") is not None:
+            desc += f", {probe['elapsed_seconds']:.0f}s elapsed"
+        if probe.get("sample_count"):
+            desc += f", {probe['sample_count']} stack samples"
+        lines.append(desc)
+        if probe.get("deepest_frame"):
+            lines.append(f"  deepest sampled frame: "
+                         f"{probe['deepest_frame']}")
+        if state in ("wedged", "pending") and probe.get("phase"):
+            diagnosis.append(
+                f"backend init {state} in probe phase "
+                f"'{probe['phase']}'"
+                + (f" at {probe['deepest_frame']}"
+                   if probe.get("deepest_frame") else ""))
+        elif state == "failed" and probe.get("detail"):
+            diagnosis.append(
+                f"backend init failed: {probe['detail'][:120]}")
 
     # -- resources --------------------------------------------------------
     samples = bundle.get("resources") or []

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from typing import Any
 
@@ -62,6 +63,22 @@ def resolve_out(flag: str) -> str:
     if history_dir:
         return os.path.join(history_dir, HISTORY_BASENAME)
     return ""
+
+
+def probe_label() -> str:
+    """This process's device-route probe verdict for the history
+    record: ``ok`` | ``wedged`` | ``failed`` | ``pending`` |
+    ``absent`` | ``disabled``. Resolved WITHOUT importing the device
+    stack: if ``ops.backend`` was never imported, no probe ran —
+    that's ``absent`` — and a pull/push must not pay a jax import for
+    a telemetry label."""
+    mod = sys.modules.get("makisu_tpu.ops.backend")
+    if mod is None:
+        return "absent"
+    try:
+        return str(mod.probe_label())
+    except Exception:  # noqa: BLE001 - a label must never fail a build
+        return "absent"
 
 
 def record_from_report(report: dict, command: str = "",
@@ -116,6 +133,11 @@ def record_from_report(report: dict, command: str = "",
         "native_isa": info_labels.get("native_isa", ""),
         "mode": info_labels.get("mode", ""),
         "hasher": info_labels.get("hasher", ""),
+        # Device-route state during this build: `history diff` uses it
+        # to attribute latency swings to route changes (a build whose
+        # chunk hashing degraded to whole-layer caching because the
+        # backend wedged is slower for reasons no code change made).
+        "device_probe": probe_label(),
     }
     record.update(extra)
     return record
@@ -188,6 +210,15 @@ def aggregate(records: list[dict]) -> dict:
         out["duration_p99"] = round(
             metrics.percentile(durations, 99), 6)
         out["duration_max"] = round(max(durations), 6)
+    # Dominant device-route label across the set (records without the
+    # label — pre-PR-9 files — contribute nothing).
+    probes: dict[str, int] = {}
+    for r in records:
+        label = r.get("device_probe")
+        if label:
+            probes[label] = probes.get(label, 0) + 1
+    if probes:
+        out["device_probe"] = max(sorted(probes), key=probes.get)
     return out
 
 
@@ -225,13 +256,22 @@ def diff(a: list[dict], b: list[dict],
                 "candidate": vb,
                 "change": round(change, 4),
             })
-    return {
+    result: dict[str, Any] = {
         "baseline": agg_a,
         "candidate": agg_b,
         "threshold": threshold,
         "regressions": regressions,
         "ok": not regressions,
     }
+    # Device-route attribution: a p50/p99 swing alongside a route-state
+    # change (ok → wedged: chunk hashing degraded to whole-layer
+    # caching) is environment, not code — the diff names it so the
+    # gate's reader doesn't chase a phantom regression.
+    da, db = agg_a.get("device_probe"), agg_b.get("device_probe")
+    if da and db and da != db:
+        result["device_probe_change"] = {"baseline": da,
+                                         "candidate": db}
+    return result
 
 
 # -- renderers -------------------------------------------------------------
@@ -257,7 +297,9 @@ def render_trends(records: list[dict], limit: int = 20) -> str:
     lines.append(
         f"cache hit ratio {100.0 * agg['cache_hit_ratio']:.1f}%  "
         f"chunk dedup {100.0 * agg['chunk_dedup_ratio']:.1f}%  "
-        f"failures {agg['failures']}/{agg['records']}")
+        f"failures {agg['failures']}/{agg['records']}"
+        + (f"  device route {agg['device_probe']}"
+           if agg.get("device_probe") else ""))
     lines.append("")
     shown = records[-limit:]
     if len(records) > limit:
@@ -299,6 +341,12 @@ def render_diff(result: dict) -> str:
             delta = f"  ({100.0 * (vb - va) / va:+.1f}%)"
         lines.append(f"  {key:<18s} {va:10.4f} → {vb:10.4f}{delta}"
                      + ("  ← REGRESSION" if flagged else ""))
+    change = result.get("device_probe_change")
+    if change:
+        lines.append(
+            f"  device route: {change['baseline']} → "
+            f"{change['candidate']}  (latency deltas may be "
+            f"device-route state, not code)")
     lines.append("")
     if result["regressions"]:
         names = ", ".join(r["metric"] for r in result["regressions"])

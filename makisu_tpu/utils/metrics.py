@@ -50,6 +50,16 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
 COMMIT_STAGE_BUSY = "makisu_commit_stage_busy_seconds"
 COMMIT_QUEUE_DEPTH = "makisu_commit_queue_depth"
 
+# Device execution telemetry (ops/backend.py note_device_dispatch):
+# one name set shared by the HashService, the chunker's lane batcher,
+# the /healthz device section, and the docs' metric table — per lane
+# bucket: program round-trip latency, first-dispatch (compile) cost,
+# bytes shipped host→device, and padded−real waste inside filled lanes.
+DEVICE_DISPATCH_SECONDS = "makisu_device_dispatch_seconds"
+DEVICE_COMPILE_SECONDS = "makisu_device_compile_seconds"
+DEVICE_H2D_BYTES = "makisu_device_h2d_bytes_total"
+DEVICE_PADDING_WASTE = "makisu_device_padding_waste_bytes_total"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.

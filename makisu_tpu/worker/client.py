@@ -108,6 +108,17 @@ class WorkerHealth(dict):
     def transfer_inflight_bytes(self) -> int:
         return int(self.get("transfer_inflight_bytes", 0))
 
+    @property
+    def device(self) -> dict:
+        """The ``device`` section: probe state + dispatch digests."""
+        return dict(self.get("device", {}))
+
+    @property
+    def device_probe_state(self) -> str:
+        """Probe verdict: ok|pending|wedged|failed|absent|disabled."""
+        return str(self.get("device", {}).get("probe", {})
+                   .get("state", ""))
+
 
 class BuildInfo(dict):
     """One row of ``GET /builds`` with typed accessors."""

@@ -30,6 +30,7 @@ import json
 import os
 import socket
 import socketserver
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
@@ -481,7 +482,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # lazily on the first build that asks for the tpu hasher.
         if _warm_probe_wanted():
             from makisu_tpu.ops import backend as _backend
-            _backend.warm_probe()
+            _backend.warm_probe(source="worker")
         # Builds sharing a --root or --storage directory would race on
         # the filesystem; those (and only those) serialize.
         self._path_locks: dict[str, threading.Lock] = {}
@@ -723,6 +724,22 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 tenant: ring.stats()
                 for tenant, ring in sorted(tenant_rings.items())},
         }
+        # Device-route vitals: probe state/phase/heartbeat (a wedged
+        # backend init is visible to a probe BEFORE any build pays the
+        # bounded wait) + per-bucket dispatch latency and byte
+        # economics once a backend is serving programs. Consulted only
+        # when something already imported the device stack (same gate
+        # as flightrecorder/history): a cpu-only worker's first
+        # /healthz must not block on a multi-second jax import.
+        device = {"probe": {"state": "absent", "sample_count": 0},
+                  "dispatch_seconds": {}, "h2d_bytes": 0,
+                  "padding_waste_bytes": 0}
+        ops_backend = sys.modules.get("makisu_tpu.ops.backend")
+        if ops_backend is not None:
+            try:
+                device = ops_backend.device_health()
+            except Exception:  # noqa: BLE001 - healthz always answers
+                device = {"probe": {"state": "error"}}
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -733,6 +750,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "active_builds": started - succeeded - failed,
             "queue": queue,
             "cache": cache,
+            "device": device,
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on
             # active_builds > 0 && last_progress_seconds > window sees

@@ -32,6 +32,10 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # production; in tests a device error is a BUG — fail loudly. The
 # degradation tests opt out per-test.
 os.environ.setdefault("MAKISU_TPU_CHUNK_STRICT", "1")
+# The device-session ledger (utils/deviceprobe.py) must never write
+# into the repo's benchmarks/device_sessions from a test run; tests
+# that exercise it point the env var at a tmp dir explicitly.
+os.environ.setdefault("MAKISU_TPU_DEVICE_SESSIONS_DIR", "")
 
 
 import pytest  # noqa: E402

@@ -24,6 +24,7 @@ def fresh_probe(monkeypatch):
     monkeypatch.setattr(backend, "_probe_start", 0.0)
     monkeypatch.setattr(backend, "_timed_out", False)
     monkeypatch.setattr(backend, "_grace_spent", False)
+    monkeypatch.setattr(backend, "_tracker", backend._ProbeTracker())
     yield
 
 
@@ -169,6 +170,56 @@ def test_wedge_verdict_keyed_by_attachment_env(fresh_probe, monkeypatch):
     assert backend._read_cached_wedge() is not None
     monkeypatch.setenv("TPU_ENDPOINT", "other-tunnel:8476")
     assert backend._read_cached_wedge() is None
+
+
+def test_wedge_verdict_key_excludes_process_local_vars(fresh_probe,
+                                                       monkeypatch):
+    """ATTACHMENT_ENV_EXCLUDE vars (per-PROCESS, not per-attachment:
+    worker id, process port, visible devices) stay OUT of the verdict
+    key — folding them in would give every worker process a unique key
+    and silently defeat cross-process verdict sharing."""
+
+    def hang_probe():
+        pass
+
+    monkeypatch.setenv("TPU_ENDPOINT", "tunnel:8476")
+    monkeypatch.setattr(backend, "_probe", hang_probe)
+    assert backend.backend_ready(timeout=0.05) is not None
+    assert backend._read_cached_wedge() is not None
+    # A "sibling worker" differing only in process-local vars still
+    # inherits the verdict...
+    monkeypatch.setenv("TPU_PROCESS_PORT", "9999")
+    monkeypatch.setenv("TPU_WORKER_ID", "7")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0")
+    assert backend._read_cached_wedge() is not None
+    # ...while a real attachment difference re-keys it.
+    monkeypatch.setenv("TPU_ENDPOINT", "other-tunnel:1")
+    assert backend._read_cached_wedge() is None
+
+
+def test_wedge_verdict_ttl_expiry_reprobes(fresh_probe, monkeypatch):
+    """An expired verdict is not hearsay anymore: the next process
+    pays its OWN bounded wait (the probe actually restarts) instead of
+    degrading instantly on stale evidence."""
+
+    def hang_probe():
+        pass
+
+    monkeypatch.setattr(backend, "_probe", hang_probe)
+    assert backend.backend_ready(timeout=0.05) is not None
+
+    monkeypatch.setenv("MAKISU_TPU_PROBE_CACHE_TTL", "0.001")
+    time.sleep(0.01)
+    # "Second process": fresh in-process state, expired verdict file.
+    backend._done = threading.Event()
+    backend._result = [None]
+    backend._started = False
+    backend._timed_out = False
+    backend._grace_spent = False
+    err = backend.backend_ready(timeout=0.05)
+    assert err is not None and "did not complete" in err
+    assert "another process" not in err  # own probe, not the cache
+    assert backend._started is True      # the probe really restarted
 
 
 def test_wedge_verdict_expires_and_clears(fresh_probe, monkeypatch):

@@ -190,3 +190,37 @@ def test_batch_occupancy_metric(service):
     assert batches == service.batches
     # Occupancy is a fraction of lane capacity: (0, 1] per batch.
     assert 0 < sum_after / count_after <= 1.0
+
+
+def test_device_dispatch_telemetry(service):
+    """Every dispatched program exports the device execution set
+    alongside occupancy: per-bucket dispatch latency (histogram + the
+    exact ring /healthz serves), first-dispatch compile gauge, H2D
+    bytes (the full padded buffer ships), and padding waste
+    (padded−real inside the filled lanes — the number the ragged-batch
+    device path exists to erase)."""
+    from makisu_tpu.ops import backend
+    from makisu_tpu.utils import metrics
+
+    g = metrics.global_registry()
+    before_h2d = g.counter_total(metrics.DEVICE_H2D_BYTES)
+    before_waste = g.counter_total(metrics.DEVICE_PADDING_WASTE)
+    payloads = [np.random.default_rng(500 + i).integers(
+        0, 256, size=4000, dtype=np.uint8).tobytes()
+        for i in range(8)]
+    for p, fut in [(p, service.submit(p)) for p in payloads]:
+        assert fut.result(timeout=60) == hashlib.sha256(p).digest()
+    h2d = g.counter_total(metrics.DEVICE_H2D_BYTES) - before_h2d
+    waste = g.counter_total(metrics.DEVICE_PADDING_WASTE) - before_waste
+    # The whole [512, 16KiB] buffer ships per program, however few
+    # lanes are filled.
+    assert h2d >= 512 * 16 * 1024
+    # 4000-byte chunks in 16KiB lanes: >12KiB waste per filled lane.
+    assert waste >= 8 * (16 * 1024 - 4000) * 0.99
+    assert g.gauge_value(metrics.DEVICE_COMPILE_SECONDS,
+                         bucket=16 * 1024) > 0
+    stats = backend.dispatch_stats()
+    assert stats.get(str(16 * 1024), {}).get("count", 0) >= 1
+    health = backend.device_health()
+    assert health["h2d_bytes"] > 0
+    assert str(16 * 1024) in health["dispatch_seconds"]

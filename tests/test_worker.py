@@ -360,6 +360,16 @@ def test_healthz(tmp_path, worker):
     assert before["last_progress_seconds"] >= 0
     assert before["transfer_inflight_bytes"] >= 0
     assert before["transfer_queue_depth"] >= 0
+    # Device-route vitals: probe state + execution-plane aggregates
+    # ride /healthz so a wedged backend init is visible to a poller
+    # before any build pays the bounded wait.
+    device = before["device"]
+    assert device["probe"]["state"] in (
+        "ok", "pending", "wedged", "failed", "absent", "disabled")
+    assert "dispatch_seconds" in device
+    assert device["h2d_bytes"] >= 0
+    assert device["padding_waste_bytes"] >= 0
+    assert before.device_probe_state == device["probe"]["state"]
 
     ctx = tmp_path / "hctx"
     ctx.mkdir()
