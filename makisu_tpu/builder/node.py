@@ -79,8 +79,35 @@ class BuildNode:
     def _apply_layer(self, pair: DigestPair, modify_fs: bool,
                      cache_mgr=None) -> None:
         hex_digest = pair.gzip_descriptor.digest.hex()
+        # Resident-session fast path: a layer this session has already
+        # folded into a MemFS tree at this exact chain position replays
+        # from its recorded applied-entry stream — no blob open, no
+        # gzip inflate, no tar parse, no per-entry diff. The memo keys
+        # on (applied-chain, digest): the recorded ops bake in the
+        # prior tree state's diff outcome, so the same blob applied at
+        # a different position (Dockerfile reorder) records fresh
+        # instead of replaying stale state. Only for in-memory
+        # application (modify_fs must hit the disk), and only on an
+        # untainted chain (every prior layer named itself).
+        memfs = self.ctx.memfs
+        session = getattr(self.ctx, "session", None)
+        memo_ok = (session is not None and not modify_fs
+                   and not memfs.chain_tainted)
+        if memo_ok:
+            memo_key = (memfs.applied_chain, hex_digest)
+            ops = session.replay_lookup(memo_key)
+            if ops is not None:
+                log.info("replaying resident layer %s (%d entries)",
+                         hex_digest[:12], len(ops))
+                with metrics.span("apply_layer",
+                                  digest=hex_digest[:12], replay=True):
+                    memfs.replay_layer(ops, chain_key=hex_digest)
+                metrics.counter_add(
+                    "makisu_cached_layers_applied_total")
+                return
         log.info("applying cached layer %s (unpack=%s)", hex_digest,
                  modify_fs)
+        record = [] if memo_ok else None
         # Application consumes the UNCOMPRESSED tar stream; route it
         # through the cache manager when it can supply one — with chunk
         # dedup attached, a lazily-pulled layer streams straight from
@@ -90,13 +117,18 @@ class BuildNode:
             if open_tar is not None:
                 with open_tar(pair) as gz:
                     with tarfile.open(fileobj=gz, mode="r|") as tf:
-                        self.ctx.memfs.update_from_tar(tf, untar=modify_fs)
+                        memfs.update_from_tar(
+                            tf, untar=modify_fs, record=record,
+                            chain_key=hex_digest)
             else:
                 with self.ctx.image_store.layers.open(hex_digest) as f:
                     with tario.gzip_reader(f) as gz:
                         with tarfile.open(fileobj=gz, mode="r|") as tf:
-                            self.ctx.memfs.update_from_tar(
-                                tf, untar=modify_fs)
+                            memfs.update_from_tar(
+                                tf, untar=modify_fs, record=record,
+                                chain_key=hex_digest)
+        if record is not None:
+            session.replay_store(memo_key, record)
         # After the span: a failed application must not count.
         metrics.counter_add("makisu_cached_layers_applied_total")
 

@@ -173,6 +173,20 @@ def make_parser() -> argparse.ArgumentParser:
     build.add_argument("--hasher", default="cpu", choices=["cpu", "tpu"],
                        help="layer hashing backend; tpu adds CDC chunk "
                             "fingerprints for chunk-granular caching")
+    build.add_argument("--watch", action="store_true",
+                       help="stay resident after the build and rebuild "
+                            "whenever context files change (inotify "
+                            "when available, mtime-poll fallback); the "
+                            "resident build session keeps the stat "
+                            "cache, scan memos, and applied-layer "
+                            "state warm so each rebuild re-scans and "
+                            "re-chunks only dirtied files. Ctrl-C "
+                            "exits")
+    build.add_argument("--watch-interval", type=float, default=1.0,
+                       metavar="SECONDS",
+                       help="change-poll interval for --watch "
+                            "(default 1.0; inotify hosts poll the "
+                            "event queue at this cadence)")
 
     pull = sub.add_parser("pull", help="pull an image into the store")
     pull.add_argument("image")
@@ -381,6 +395,103 @@ def _new_cache_manager(args, store, registry_client=None):
 
 
 def cmd_build(args) -> int:
+    if getattr(args, "watch", False):
+        if invocation_mode.get() == "worker":
+            # A worker build runs on a handler thread; an endless
+            # watch loop would pin it (and its session lease) forever.
+            # The worker process is already resident — repeat
+            # submissions get warm rebuilds without watching.
+            log.warning("--watch is ignored in worker mode (the "
+                        "worker itself is the resident process)")
+        else:
+            return _watch_loop(args)
+    return _build_once(args)
+
+
+def _watch_loop(args) -> int:
+    """``build --watch``: build, then stay resident and rebuild on
+    every context change. Change detection rides the build session's
+    dirty tracker (inotify when available); without a session (
+    MAKISU_TPU_SESSION=0) a standalone mtime-walk snapshot polls. A
+    failed rebuild keeps watching — the next edit gets its chance."""
+    import importlib
+    import time as time_mod
+
+    from makisu_tpu.worker import session as session_mod
+    walk_mod = importlib.import_module("makisu_tpu.snapshot.walk")
+
+    interval = max(0.1, getattr(args, "watch_interval", 1.0))
+    context_dir = os.path.abspath(args.context)
+    # The standalone (session-less) poll must ignore the build's own
+    # output dirs — a storage/root nested inside the context would
+    # otherwise re-trigger a rebuild forever.
+    poll_blacklist = [os.path.abspath(_storage_dir(args.storage)),
+                      os.path.abspath(args.root)]
+
+    def safe_build() -> int:
+        """One rebuild that can never unwind the loop: a momentarily
+        broken Dockerfile or a half-renamed COPY source is the normal
+        rhythm of watch-mode editing — report, keep watching."""
+        try:
+            return _build_once(args)
+        except KeyboardInterrupt:
+            raise
+        except SystemExit as e:
+            log.error("watch: build exited: %s", e.code)
+            return e.code if isinstance(e.code, int) else 1
+        except Exception as e:  # noqa: BLE001 - watch must survive
+            log.error("watch: build failed: %s", e)
+            return 1
+
+    code = safe_build()
+    builds = 1
+    snapshot = None
+    log.info("watch: initial build exited %d; watching %s "
+             "(interval %.1fs, Ctrl-C to exit)", code, context_dir,
+             interval)
+    try:
+        while True:
+            session = session_mod.manager().peek(context_dir)
+            if session is not None:
+                dirt = session.poll_changes()
+            else:
+                try:
+                    if snapshot is None:
+                        snapshot = walk_mod.snapshot_tree(
+                            context_dir, poll_blacklist)
+                        dirt = set()
+                    else:
+                        snapshot, delta = walk_mod.snapshot_delta(
+                            snapshot, poll_blacklist)
+                        dirt = delta.real_dirty
+                except OSError:
+                    # Context churned mid-walk (or vanished briefly):
+                    # re-baseline next tick instead of dying.
+                    snapshot = None
+                    dirt = set()
+            if dirt:
+                sample = sorted(dirt)[:3]
+                log.info("watch: %d paths changed (%s); rebuilding",
+                         len(dirt), ", ".join(
+                             os.path.relpath(p, context_dir)
+                             for p in sample))
+                code = safe_build()
+                builds += 1
+                log.info("watch: rebuild #%d exited %d", builds, code)
+                snapshot = None  # re-baseline the standalone poll
+            else:
+                time_mod.sleep(interval)
+    except KeyboardInterrupt:
+        # A terminal Ctrl-C is delivered to the whole process group —
+        # a second interrupt may land mid-log; exit quietly either way.
+        try:
+            log.info("watch: stopped after %d builds", builds)
+        except KeyboardInterrupt:
+            pass
+        return code
+
+
+def _build_once(args) -> int:
     from makisu_tpu.builder import BuildPlan
     from makisu_tpu.cache import NoopCacheManager
     from makisu_tpu.chunker import get_hasher
@@ -443,7 +554,43 @@ def cmd_build(args) -> int:
             from makisu_tpu.storage.root_preserver import RootPreserver
             preserver = RootPreserver(args.root, store.sandbox_dir,
                                       ctx.blacklist)
+        # Resident build session: lease (or mint) the warm state for
+        # this context + resolved-flag identity. A reused session arms
+        # the context with the dirty set, the scan memo, and the
+        # resident statcache/layer state; every outcome lands on the
+        # decision ledger (source=session) and the warm_mode history
+        # label. Leased IMMEDIATELY before the try whose finally
+        # releases it — any fallible setup between acquire and release
+        # would leak the session busy forever.
+        from makisu_tpu.utils import ledger as ledger_mod
+        from makisu_tpu.worker import session as session_mod
+        build_session = None
+        abs_context = os.path.abspath(args.context)
+        if session_mod.enabled():
+            build_session, verdict = session_mod.manager().acquire(
+                abs_context, session_mod.identity_from_build_args(
+                    args, _storage_dir(args.storage), gzip_backend_id))
+        else:
+            verdict = "disabled"
+        build_ok = False
         try:
+            if build_session is not None:
+                mode = build_session.begin_build(
+                    ctx,
+                    resident_process=(
+                        invocation_mode.get() == "worker"
+                        or bool(getattr(args, "watch", False))))
+                session_mod.set_warm_mode(
+                    mode if verdict == "hit" else "fresh")
+                ledger_mod.record(
+                    "session", abs_context, verdict,
+                    reason="reused" if verdict == "hit" else "created",
+                    mode=mode, dirty=len(ctx.dirty_paths),
+                    resident_bytes=build_session.resident_bytes())
+            else:
+                session_mod.set_warm_mode("off")
+                ledger_mod.record("session", abs_context, "miss",
+                                  reason=verdict)
             plan = BuildPlan(ctx, target, replicas, cache_mgr, stages,
                              allow_modify_fs=args.modifyfs,
                              force_commit=(args.commit == "implicit"),
@@ -451,9 +598,16 @@ def cmd_build(args) -> int:
                              registry_client=_FromPuller(
                                  store, registry_config_map))
             manifest = plan.execute()
+            build_ok = True
         finally:
             if preserver is not None:
                 preserver.restore()
+            if build_session is not None:
+                # A failed build de-certifies the dirty set (the next
+                # build re-scans); a successful one re-arms the
+                # watcher/snapshot so the next rebuild is O(dirty).
+                build_session.finish_build(ctx, build_ok)
+                session_mod.manager().release(build_session)
         log.info("successfully built image %s", target)
 
         # Lazily-pulled cache hits hold no local blob; pushes

@@ -66,6 +66,31 @@ class BuildContext:
         self.content_ids = ContentIDCache(
             os.path.join(image_store.root, "content_id_cache.json"),
             namespace=os.path.abspath(context_dir))
+        # Resident build session (worker/session.py), armed by
+        # session.begin_build for warm rebuilds: dirty_paths is the set
+        # of context paths that changed since the last build of this
+        # context, dirty_exact says whether that set provably covers
+        # every change (only then may scans be skipped).
+        self.session = None
+        self.dirty_paths: frozenset[str] = frozenset()
+        self.dirty_exact = False
+
+    def source_unchanged(self, path: str) -> bool:
+        """True when the resident session PROVES nothing under ``path``
+        changed since the last build: the dirty set is exact and no
+        dirty path is ``path``, below it, or an ANCESTOR of it (a
+        renamed/moved parent dirties every source inside it even when
+        the watcher only evented the parent itself). Gate for every
+        scan-memo shortcut — when this is False, the full walk runs
+        (cold-path semantics, cold-path results)."""
+        if not self.dirty_exact:
+            return False
+        prefix = path.rstrip("/") + "/"
+        for dirty in self.dirty_paths:
+            if (dirty == path or dirty.startswith(prefix)
+                    or prefix.startswith(dirty.rstrip("/") + "/")):
+                return False
+        return True
 
     def context_excluded_paths(self) -> list[str]:
         """Absolute context paths excluded by .dockerignore (empty when
@@ -118,4 +143,9 @@ class BuildContext:
         # SHARED, not fresh: stages hash the same context files, and
         # the plan saves the base context's cache once at the end.
         ctx.content_ids = self.content_ids
+        # Session state is shared too: every stage scans the same
+        # context tree under the same dirty set.
+        ctx.session = self.session
+        ctx.dirty_paths = self.dirty_paths
+        ctx.dirty_exact = self.dirty_exact
         return ctx

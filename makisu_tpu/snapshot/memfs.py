@@ -85,6 +85,23 @@ class MemFS:
         self.tree = Node(root, "/", hdr)
         self.layers: list[Layer] = []
         self._isa_logged = False  # route logged once per build (MemFS)
+        # When set (a list), _apply_entry mirrors every applied entry
+        # into it — the op stream replay_layer folds back verbatim.
+        self._record_ops: list | None = None
+        # Applied-layer chain identity: a rolling digest over the
+        # layers folded into this tree, in order. A recorded op stream
+        # is only valid at the exact chain position it was recorded at
+        # (the ops bake in that tree state's diff outcome), so the
+        # session's replay memo keys on (applied_chain, digest). Any
+        # tar merge that can't name its layer taints the chain and
+        # turns the memo off for this tree.
+        self.applied_chain = ""
+        self.chain_tainted = False
+
+    def extend_chain(self, digest_hex: str) -> None:
+        import hashlib
+        self.applied_chain = hashlib.sha256(
+            (self.applied_chain + digest_hex).encode()).hexdigest()
 
     # ------------------------------------------------------------------
     # Tree bookkeeping
@@ -99,6 +116,8 @@ class MemFS:
 
     def _apply_entry(self, entry: ContentEntry | WhiteoutEntry) -> None:
         """Fold a layer entry into the tree."""
+        if self._record_ops is not None:
+            self._record_ops.append(entry)
         if isinstance(entry, WhiteoutEntry):
             parts = pathutils.split_path(entry.deleted)
             node = self.tree
@@ -198,6 +217,10 @@ class MemFS:
             layer.commit(tw)
         finally:
             metrics.stage_busy_add("tar_write", time.monotonic() - t0)
+        # A commit folded entries into the tree without a chain key
+        # (its digest exists only after the fact): any later cached
+        # application on this tree must bypass the replay memo.
+        self.chain_tainted = True
         self.layers.append(layer)
 
     def _create_layer_by_scan(self) -> Layer:
@@ -341,38 +364,78 @@ class MemFS:
                 with tarfile.open(fileobj=gz, mode="r|") as tf:
                     return self.update_from_tar(tf, untar)
 
-    def update_from_tar(self, tf: tarfile.TarFile, untar: bool) -> Layer:
+    def update_from_tar(self, tf: tarfile.TarFile, untar: bool,
+                        record: list | None = None,
+                        chain_key: str | None = None) -> Layer:
         """Merge one layer tar into the tree; ``untar`` also materializes
         it on disk. Hardlinks apply in a second pass (their targets may
         appear later in the tar); parent-directory mtimes are restored
-        after extraction."""
+        after extraction.
+
+        ``record`` (a list to fill) captures the exact entry stream
+        this application folded into the tree — the input
+        :meth:`replay_layer` accepts, so a resident build session can
+        re-apply this layer without re-inflating the blob.
+        ``chain_key`` names the layer (its blob digest) for the
+        applied-chain identity; merges that can't name one taint the
+        chain (diff/extract flows, which never consult the memo)."""
         layer = Layer()
         hardlinks: list[tuple[str, tarfile.TarInfo]] = []
         parent_mtimes: dict[str, float] = {}
-        for hdr in tf:
-            hdr.name = pathutils.rel_path(hdr.name)
-            disk_path = pathutils.join_root(self.root, hdr.name)
-            if self._skip_tar_member(disk_path, hdr):
-                continue
-            if untar:
-                parent = os.path.dirname(disk_path)
-                if parent not in parent_mtimes:
-                    parent_mtimes[parent] = os.lstat(parent).st_mtime
-            if hdr.islnk():
-                hdr.linkname = pathutils.abs_path(hdr.linkname)
-                hardlinks.append((disk_path, hdr))
-                continue
-            if untar:
-                self._untar_one(disk_path, hdr, tf)
-            self._maybe_add(layer, disk_path, pathutils.abs_path(hdr.name),
-                            hdr, create_whiteouts=False)
-        for disk_path, hdr in hardlinks:
-            if untar:
-                self._untar_one(disk_path, hdr, None)
-            self._maybe_add(layer, disk_path, pathutils.abs_path(hdr.name),
-                            hdr, create_whiteouts=False)
+        if record is not None:
+            self._record_ops = record
+        try:
+            for hdr in tf:
+                hdr.name = pathutils.rel_path(hdr.name)
+                disk_path = pathutils.join_root(self.root, hdr.name)
+                if self._skip_tar_member(disk_path, hdr):
+                    continue
+                if untar:
+                    parent = os.path.dirname(disk_path)
+                    if parent not in parent_mtimes:
+                        parent_mtimes[parent] = \
+                            os.lstat(parent).st_mtime
+                if hdr.islnk():
+                    hdr.linkname = pathutils.abs_path(hdr.linkname)
+                    hardlinks.append((disk_path, hdr))
+                    continue
+                if untar:
+                    self._untar_one(disk_path, hdr, tf)
+                self._maybe_add(layer, disk_path,
+                                pathutils.abs_path(hdr.name),
+                                hdr, create_whiteouts=False)
+            for disk_path, hdr in hardlinks:
+                if untar:
+                    self._untar_one(disk_path, hdr, None)
+                self._maybe_add(layer, disk_path,
+                                pathutils.abs_path(hdr.name),
+                                hdr, create_whiteouts=False)
+        finally:
+            self._record_ops = None
         for parent, mtime in parent_mtimes.items():
             os.utime(parent, (mtime, mtime))
+        if chain_key is not None:
+            self.extend_chain(chain_key)
+        else:
+            self.chain_tainted = True
+        self.layers.append(layer)
+        return layer
+
+    def replay_layer(self, ops: list, chain_key: str = "") -> Layer:
+        """Fold a previously recorded applied-entry stream into the
+        tree — the same tree mutations ``update_from_tar(...,
+        untar=False)`` made from the blob, with zero decompression,
+        zero tar parsing, and zero per-entry diffing (the record IS
+        the diff outcome, valid because replay happens at the same
+        layer-chain position over the same prior tree state — the
+        session's digest-keyed lookup guarantees it). Per-entry cost
+        drops to one tree fold, which is what makes a 100k-entry
+        cached chain replay in about a second instead of several."""
+        layer = Layer()
+        for entry in ops:
+            self._apply_entry(entry)
+        if chain_key:
+            self.extend_chain(chain_key)
         self.layers.append(layer)
         return layer
 

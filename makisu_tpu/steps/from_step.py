@@ -143,8 +143,12 @@ class FromStep(BuildStep):
                     with tario.gzip_reader(f) as gz:
                         import tarfile
                         with tarfile.open(fileobj=gz, mode="r|") as tf:
-                            ctx.memfs.update_from_tar(tf,
-                                                      untar=modify_fs)
+                            # chain_key keeps the applied-chain
+                            # identity intact, so cached layers ABOVE
+                            # this base stay replay-memoizable.
+                            ctx.memfs.update_from_tar(
+                                tf, untar=modify_fs,
+                                chain_key=descriptor.digest.hex())
         except BaseException:
             self._abandon_pull()
             raise

@@ -29,6 +29,15 @@ class CASStore:
     bounds the store; least-recently-used entries are evicted on overflow.
     """
 
+    # Stores below this cap seed their LRU map eagerly at construction
+    # (a few hundred stats); at or above it — the ~1M-entry chunk CAS,
+    # where the seed scan is tens of thousands of stats and was a
+    # measurable warm-rebuild floor term — seeding runs on a background
+    # thread armed by the first write, and eviction simply defers until
+    # the scan lands (advisory LRU: a few deferred evictions cost disk
+    # headroom, never correctness).
+    _EAGER_SEED_BELOW = 4096
+
     def __init__(self, root: str, max_entries: int = 256) -> None:
         self.root = root
         self.max_entries = max_entries
@@ -37,8 +46,40 @@ class CASStore:
         os.makedirs(root, exist_ok=True)
         self._tmp_dir = os.path.join(root, "_tmp")
         os.makedirs(self._tmp_dir, exist_ok=True)
-        for name in self.keys():
-            self._last_access[name] = os.path.getmtime(self._path(name))
+        self._seeded = False
+        self._seeding = False
+        if max_entries < self._EAGER_SEED_BELOW:
+            for name in self.keys():
+                self._last_access[name] = \
+                    os.path.getmtime(self._path(name))
+            self._seeded = True
+
+    def _seed_async_locked(self) -> None:
+        """Arm the background LRU seed (large stores). Runs at most
+        once; merges on-disk mtimes under the lock, live accesses
+        recorded meanwhile win, then catches up deferred eviction."""
+        if self._seeded or self._seeding:
+            return
+        self._seeding = True
+
+        def run() -> None:
+            seed: dict[str, float] = {}
+            try:
+                for name in self.keys():
+                    try:
+                        seed[name] = os.path.getmtime(self._path(name))
+                    except OSError:
+                        pass  # racing delete
+            finally:
+                with self._lock:
+                    for name, mtime in seed.items():
+                        self._last_access.setdefault(name, mtime)
+                    self._seeded = True
+                    self._seeding = False
+                    self._evict_locked()
+
+        threading.Thread(target=run, daemon=True,
+                         name="cas-lru-seed").start()
 
     def _path(self, name: str) -> str:
         shard = name[:_SHARD_CHARS] if len(name) > _SHARD_CHARS else "__"
@@ -171,6 +212,11 @@ class CASStore:
         of THOSE would force re-pulls the old one-at-a-time policy
         never did."""
         import heapq
+        if not self._seeded:
+            # LRU state still loading (large store, background seed):
+            # defer — the seed's completion re-runs this.
+            self._seed_async_locked()
+            return
         if len(self._last_access) <= self.max_entries:
             return
         excess = len(self._last_access) - self.max_entries

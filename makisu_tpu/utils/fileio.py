@@ -10,10 +10,39 @@ override policies for COPY --chown / context copies / --archive.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import shutil
+import threading
 
 from makisu_tpu.utils import pathutils, sysutils
+
+
+def write_json_atomic(path: str, payload, default=str) -> None:
+    """Crash-safe JSON write: serialize to a uniquely-named temp file
+    in the destination directory, fsync it, then rename over ``path``.
+    A reader (or the next build) sees either the old complete file or
+    the new complete file — never a truncation, even across a SIGTERM
+    mid-write or a power cut after the rename (the fsync orders the
+    data before the metadata). The temp name carries pid AND thread id:
+    concurrent builds in one worker process must not clobber each
+    other's in-flight writes."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, separators=(",", ":"),
+                      default=default)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # Unwinding through here includes a signal handler's
+        # SystemExit — the orphan temp file must not accumulate.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 @dataclasses.dataclass(frozen=True)

@@ -81,6 +81,22 @@ def probe_label() -> str:
         return "absent"
 
 
+def warm_mode_label() -> str:
+    """This build's residency state for the history record:
+    ``resident`` (session reused, dirty-set incremental), ``rescan``
+    (session reused but re-certifying), ``fresh`` (new session),
+    ``off`` (sessions disabled/bypassed), ``none`` (no build ran).
+    Resolved via sys.modules like :func:`probe_label`: if the session
+    module never loaded, no session engaged."""
+    mod = sys.modules.get("makisu_tpu.worker.session")
+    if mod is None:
+        return "none"
+    try:
+        return str(mod.warm_mode())
+    except Exception:  # noqa: BLE001 - a label must never fail a build
+        return "none"
+
+
 def record_from_report(report: dict, command: str = "",
                        exit_code: int = 0,
                        **extra: Any) -> dict:
@@ -138,6 +154,10 @@ def record_from_report(report: dict, command: str = "",
         # chunk hashing degraded to whole-layer caching because the
         # backend wedged is slower for reasons no code change made).
         "device_probe": probe_label(),
+        # Residency state: a latency swing between `resident` and
+        # `off`/`rescan` records is warm-state economics, not a code
+        # regression — `history diff` names the change.
+        "warm_mode": warm_mode_label(),
     }
     record.update(extra)
     return record
@@ -219,6 +239,15 @@ def aggregate(records: list[dict]) -> dict:
             probes[label] = probes.get(label, 0) + 1
     if probes:
         out["device_probe"] = max(sorted(probes), key=probes.get)
+    # Dominant residency label (records without it — pre-session
+    # files — contribute nothing).
+    warm: dict[str, int] = {}
+    for r in records:
+        label = r.get("warm_mode")
+        if label and label != "none":
+            warm[label] = warm.get(label, 0) + 1
+    if warm:
+        out["warm_mode"] = max(sorted(warm), key=warm.get)
     return out
 
 
@@ -271,6 +300,12 @@ def diff(a: list[dict], b: list[dict],
     if da and db and da != db:
         result["device_probe_change"] = {"baseline": da,
                                          "candidate": db}
+    # Residency attribution: a latency delta alongside a warm-mode
+    # flip (resident → off: every rebuild re-paid the scan/re-chunk
+    # floor) is residency state, not code — name it.
+    wa, wb = agg_a.get("warm_mode"), agg_b.get("warm_mode")
+    if wa and wb and wa != wb:
+        result["warm_mode_change"] = {"baseline": wa, "candidate": wb}
     return result
 
 
@@ -299,7 +334,9 @@ def render_trends(records: list[dict], limit: int = 20) -> str:
         f"chunk dedup {100.0 * agg['chunk_dedup_ratio']:.1f}%  "
         f"failures {agg['failures']}/{agg['records']}"
         + (f"  device route {agg['device_probe']}"
-           if agg.get("device_probe") else ""))
+           if agg.get("device_probe") else "")
+        + (f"  warm mode {agg['warm_mode']}"
+           if agg.get("warm_mode") else ""))
     lines.append("")
     shown = records[-limit:]
     if len(records) > limit:
@@ -347,6 +384,12 @@ def render_diff(result: dict) -> str:
             f"  device route: {change['baseline']} → "
             f"{change['candidate']}  (latency deltas may be "
             f"device-route state, not code)")
+    warm_change = result.get("warm_mode_change")
+    if warm_change:
+        lines.append(
+            f"  warm mode: {warm_change['baseline']} → "
+            f"{warm_change['candidate']}  (latency deltas may be "
+            f"residency state, not code)")
     lines.append("")
     if result["regressions"]:
         names = ", ".join(r["metric"] for r in result["regressions"])

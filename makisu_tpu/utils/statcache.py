@@ -71,6 +71,12 @@ class ContentIDCache:
         self._entries: dict[str, list] | None = None  # lazy load
         self._touched: set[str] = set()
         self._dirty = False
+        # Resident sessions flip this on: save() then runs on a
+        # background thread (serializing 100k entries is seconds of
+        # JSON on the warm path, and a resident process persists for
+        # durability only — the live dict is the source of truth).
+        self.defer_save = False
+        self._saver: threading.Thread | None = None
 
     def _load_locked(self) -> dict[str, list]:
         if self._entries is None:
@@ -140,9 +146,27 @@ class ContentIDCache:
             self._touched.add(self._ns + rel)
             self._dirty = True
 
+    def begin_build(self) -> None:
+        """Reset the per-build touched set (a resident session reuses
+        one instance across builds; pruning semantics must match a
+        freshly-constructed cache every build)."""
+        with self._lock:
+            self._touched.clear()
+
     def save(self) -> None:
-        """Atomic write-back (advisory: failures are swallowed — a cache
-        that can't persist costs re-hashing, never correctness)."""
+        """Atomic write-back via the shared fsync-then-rename helper
+        (``fileio.write_json_atomic``): a SIGTERM landing mid-save —
+        the CI-timeout kill unwinds SystemExit through here — leaves
+        either the previous complete cache or the new one on disk,
+        never a truncation that silently de-warms every later build.
+        Still advisory: plain IO failures are swallowed (a cache that
+        can't persist costs re-hashing, never correctness).
+
+        Serialization runs on a SNAPSHOT outside the lock (concurrent
+        lookups never stall behind a multi-MB json dump); with
+        ``defer_save`` set (resident sessions) the whole write runs on
+        a background thread — one saver at a time, the next save
+        coalesces."""
         with self._lock:
             if not self._dirty or self._entries is None:
                 return
@@ -150,20 +174,26 @@ class ContentIDCache:
             if len(entries) > MAX_CARRIED_ENTRIES:
                 entries = {rel: v for rel, v in entries.items()
                            if rel in self._touched}
-            # PID alone under-keys the temp name: concurrent builds in
-            # one worker PROCESS (a supported mode) would truncate each
-            # other's in-flight write and install corrupt JSON.
-            tmp = (f"{self.path}.{os.getpid()}."
-                   f"{threading.get_ident()}.tmp")
-            try:
-                with open(tmp, "w", encoding="utf-8") as f:
-                    f.write(json.dumps(
-                        {"version": VERSION, "entries": entries},
-                        separators=(",", ":")))
-                os.replace(tmp, self.path)
-                self._dirty = False
-            except OSError:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+            snapshot = dict(entries)
+            self._dirty = False
+            if self.defer_save:
+                if self._saver is not None and self._saver.is_alive():
+                    # A save is in flight with older state; mark dirty
+                    # again so the NEXT save persists this one's news.
+                    self._dirty = True
+                    return
+                self._saver = threading.Thread(
+                    target=self._write, args=(snapshot,), daemon=True,
+                    name="statcache-save")
+                self._saver.start()
+                return
+        self._write(snapshot)
+
+    def _write(self, entries: dict) -> None:
+        from makisu_tpu.utils import fileio
+        try:
+            fileio.write_json_atomic(
+                self.path, {"version": VERSION, "entries": entries})
+        except OSError:
+            with self._lock:
+                self._dirty = True  # retry on the next save

@@ -114,6 +114,16 @@ class WorkerHealth(dict):
         return dict(self.get("device", {}))
 
     @property
+    def sessions(self) -> dict:
+        """The ``sessions`` section: resident build-session digest
+        (count, resident bytes vs budget, hits, invalidations)."""
+        return dict(self.get("sessions", {}))
+
+    @property
+    def session_resident_bytes(self) -> int:
+        return int(self.get("sessions", {}).get("resident_bytes", 0))
+
+    @property
     def device_probe_state(self) -> str:
         """Probe verdict: ok|pending|wedged|failed|absent|disabled."""
         return str(self.get("device", {}).get("probe", {})
@@ -263,6 +273,35 @@ class WorkerClient:
                 raise RuntimeError(
                     f"worker /healthz returned {resp.status}")
             return WorkerHealth(json.loads(resp.read()))
+        finally:
+            conn.close()
+
+    def sessions(self) -> dict:
+        """The worker's ``GET /sessions`` payload: per-context
+        resident build sessions (builds served, hits, resident bytes,
+        dirty-tracker mode) plus invalidation tallies."""
+        conn, resp = self._request("GET", "/sessions")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /sessions returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def invalidate_sessions(self, context: str = "") -> int:
+        """Drop the named context's resident session (or every idle
+        session when ``context`` is empty); returns the dropped
+        count (``POST /sessions/invalidate``)."""
+        body = json.dumps({"context": context}).encode()
+        conn, resp = self._request("POST", "/sessions/invalidate",
+                                   body)
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /sessions/invalidate returned "
+                    f"{resp.status}")
+            return int(json.loads(resp.read()).get("invalidated", 0))
         finally:
             conn.close()
 

@@ -278,6 +278,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200,
                           json.dumps(self.server.builds()).encode(),
                           content_type="application/json")
+        elif self.path == "/sessions":
+            # Resident build sessions: per-context warm state (builds
+            # served, hits, resident bytes, dirty-tracker mode) plus
+            # the manager's invalidation tallies.
+            from makisu_tpu.worker import session as session_mod
+            self._respond(
+                200,
+                json.dumps(session_mod.manager().stats()).encode(),
+                content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -288,6 +297,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(404, b"not found")
 
     def do_POST(self) -> None:
+        if self.path == "/sessions/invalidate":
+            # Explicit session invalidation: body ``{"context": PATH}``
+            # drops that context's session, ``{}`` (or no body) drops
+            # every idle session. Busy sessions survive (their build
+            # owns them); the response reports the dropped count.
+            from makisu_tpu.worker import session as session_mod
+            length = int(self.headers.get("Content-Length", "0"))
+            context = ""
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                    context = str((body or {}).get("context", ""))
+                except (ValueError, AttributeError):
+                    self._respond(400, b"bad json body")
+                    return
+            dropped = session_mod.manager().invalidate(context)
+            self._respond(200, json.dumps(
+                {"invalidated": dropped}).encode(),
+                content_type="application/json")
+            return
         if self.path != "/build":
             self._respond(404, b"not found")
             return
@@ -740,6 +769,17 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 device = ops_backend.device_health()
             except Exception:  # noqa: BLE001 - healthz always answers
                 device = {"probe": {"state": "error"}}
+        # Resident-session vitals: count, resident-byte accounting
+        # against the budget, hit/invalidations tallies — the warm-path
+        # state a fleet scheduler routes toward (cache affinity) and an
+        # operator watches for memory pressure. The per-session rows
+        # stay on GET /sessions; /healthz carries the digest.
+        from makisu_tpu.worker import session as session_mod
+        session_stats = session_mod.manager().stats()
+        sessions = {k: session_stats[k] for k in
+                    ("count", "resident_bytes", "hits",
+                     "invalidations", "max_sessions",
+                     "max_resident_bytes")}
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -751,6 +791,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "queue": queue,
             "cache": cache,
             "device": device,
+            "sessions": sessions,
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on
             # active_builds > 0 && last_progress_seconds > window sees

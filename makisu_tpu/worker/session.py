@@ -1,0 +1,776 @@
+"""Resident build sessions: keep a context's expensive warm state alive
+across builds so the warm path is actually warm.
+
+Every rebuild used to pay full startup, a complete context re-scan, and
+re-chunking of untouched regions even when the worker process never
+died (ROADMAP item 5). A **build session** — keyed by context path +
+the resolved flag identity — keeps resident, per context:
+
+- the stat/content-ID cache (``utils/statcache.ContentIDCache``): no
+  JSON reload of 100k entries per build;
+- the context-scan memo: per ADD/COPY source subtree, the cache-ID
+  checksum transition ``(source, checksum_in) → checksum_out`` — an
+  untouched subtree's contribution replays in O(1) with zero syscalls;
+- the MemFS layer-replay memo: the header sequence of every applied
+  layer keyed by blob digest, so a cached layer folds into the MemFS
+  tree without re-inflating the blob or re-parsing the tar;
+- the dirty-set tracker: an inotify watcher (ctypes, Linux) with a
+  portable mtime-walk delta fallback (``snapshot.walk.snapshot_delta``)
+  accumulating changed paths between builds.
+
+The resolved native/JAX runtime stays resident for free (the worker is
+one process); the session records its identity so an ISA/ABI flip
+invalidates rather than silently mixing routes.
+
+Invalidation story (every reason labels
+``makisu_session_invalidations_total``):
+
+- ``flag_identity``: same context, different resolved build flags;
+- ``isa_change``: the native ISA/ABI route moved under the process;
+- ``ttl``: idle beyond ``MAKISU_TPU_SESSION_TTL`` seconds;
+- ``lru``: evicted past ``MAKISU_TPU_SESSION_MAX`` sessions or the
+  ``MAKISU_TPU_SESSION_MAX_MB`` resident-byte budget (accounted on
+  ``/healthz``);
+- ``explicit``: ``POST /sessions/invalidate`` or a manager reset.
+
+Correctness contract: a session only ever REPLAYS state that is a pure
+function of inputs that provably didn't change (stat signatures with
+the racily-clean discipline, digest-keyed layer headers), so image
+digests are byte-identical to a cold build at every point — asserted
+by the dirty-set tests and the ``northstar_incremental`` bench.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import ctypes
+import ctypes.util
+import hashlib
+import json
+import os
+import struct
+import threading
+import time
+
+import importlib
+
+from makisu_tpu.utils import ledger, metrics
+from makisu_tpu.utils import logging as log
+
+# The snapshot package re-exports the walk FUNCTION under the module's
+# own name; resolve the MODULE explicitly.
+walk_mod = importlib.import_module("makisu_tpu.snapshot.walk")
+
+# Metric names (satellite: session telemetry).
+SESSION_HITS = "makisu_session_hits"
+SESSION_INVALIDATIONS = "makisu_session_invalidations_total"
+SESSION_RESIDENT_BYTES = "makisu_session_resident_bytes"
+
+# Rough per-unit resident-byte estimates for the /healthz accounting.
+# Exact sizes would need sys.getsizeof walks per build; the budget is a
+# safety cap, not a ledger, so stable estimates beat precise churn.
+_BYTES_PER_LAYER_ENTRY = 600   # TarInfo + path strings
+_BYTES_PER_CONTENT_ID = 200    # statcache entry (key + stat quadruple)
+_BYTES_PER_MEMO = 160          # scan-memo key/value
+
+# Scan-memo entries kept per session: keys are (source, checksum_in);
+# upstream cache-ID churn mints new keys, so stale ones age out by cap.
+_SCAN_MEMO_KEEP = 512
+
+
+def enabled() -> bool:
+    """Resident sessions are on by default (a session that is never
+    reused costs one dict entry); MAKISU_TPU_SESSION=0 disables."""
+    return os.environ.get("MAKISU_TPU_SESSION", "1") == "1"
+
+
+def session_ttl() -> float:
+    try:
+        return float(os.environ.get("MAKISU_TPU_SESSION_TTL", "3600"))
+    except ValueError:
+        return 3600.0
+
+
+def max_sessions() -> int:
+    try:
+        return int(os.environ.get("MAKISU_TPU_SESSION_MAX", "8"))
+    except ValueError:
+        return 8
+
+
+def max_resident_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MAKISU_TPU_SESSION_MAX_MB", "512"))
+    except ValueError:
+        mb = 512.0
+    return int(mb * 1e6)
+
+
+def max_watches() -> int:
+    try:
+        return int(os.environ.get("MAKISU_TPU_SESSION_MAX_WATCHES",
+                                  "8192"))
+    except ValueError:
+        return 8192
+
+
+# This build's residency state for the history record's ``warm_mode``
+# label: "resident" (session reused with an exact dirty set), "fresh"
+# (new session: first build of this context/identity), "rescan"
+# (session reused but dirty knowledge was lost — full re-scan), "off"
+# (sessions disabled or bypassed), "none" (non-build command).
+_warm_mode: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "makisu_session_warm_mode", default="none")
+
+
+def warm_mode() -> str:
+    return _warm_mode.get()
+
+
+def set_warm_mode(label: str) -> None:
+    _warm_mode.set(label)
+
+
+def _isa_identity() -> str:
+    """The native route identity a session was built under. Only what
+    is ALREADY resolved: sessions must not force a native-library load
+    (cheap commands never pay `make`)."""
+    from makisu_tpu import native
+    return native.isa_route_if_resolved() or "unresolved"
+
+
+def identity_from_build_args(args, storage_dir: str,
+                             gzip_backend_id: str) -> str:
+    """Stable digest of the resolved flags that shape build identity
+    for one context. Anything here that moves mints a new session
+    (reason=flag_identity) — mixing, say, two hashers' warm state
+    would be silently wrong."""
+    ident = {
+        "context": os.path.abspath(args.context),
+        "root": os.path.abspath(args.root),
+        "storage": os.path.abspath(storage_dir),
+        "dockerfile": os.path.abspath(
+            args.file or os.path.join(args.context, "Dockerfile")),
+        "hasher": args.hasher,
+        "gzip_backend_id": gzip_backend_id,
+        "modifyfs": bool(args.modifyfs),
+        "commit": args.commit,
+        "target": args.target,
+        "build_args": sorted(args.build_arg),
+        "blacklist": sorted(args.blacklist),
+    }
+    blob = json.dumps(ident, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# -- inotify watcher --------------------------------------------------------
+
+_IN_ACCESS = 0x00000001
+_IN_MODIFY = 0x00000002
+_IN_ATTRIB = 0x00000004
+_IN_CLOSE_WRITE = 0x00000008
+_IN_MOVED_FROM = 0x00000040
+_IN_MOVED_TO = 0x00000080
+_IN_CREATE = 0x00000100
+_IN_DELETE = 0x00000200
+_IN_DELETE_SELF = 0x00000400
+_IN_MOVE_SELF = 0x00000800
+_IN_ISDIR = 0x40000000
+_IN_Q_OVERFLOW = 0x00004000
+_IN_IGNORED = 0x00008000
+_IN_NONBLOCK = 0x00000800  # O_NONBLOCK on linux
+_IN_CLOEXEC = 0x00080000   # O_CLOEXEC on linux
+
+_WATCH_MASK = (_IN_MODIFY | _IN_ATTRIB | _IN_CLOSE_WRITE
+               | _IN_MOVED_FROM | _IN_MOVED_TO | _IN_CREATE
+               | _IN_DELETE | _IN_DELETE_SELF | _IN_MOVE_SELF)
+
+_EVENT_HDR = struct.Struct("iIII")  # wd, mask, cookie, len
+
+
+def _libc():
+    name = ctypes.util.find_library("c")
+    return ctypes.CDLL(name, use_errno=True) if name else None
+
+
+class InotifyWatcher:
+    """Recursive inotify watch over a context tree. Best-effort by
+    design: any failure (no inotify, watch-limit ENOSPC, queue
+    overflow, structural events that stale the wd→path map) flips
+    ``healthy`` off and the session falls back to the mtime-walk
+    delta. ``collect()`` drains pending events into a dirty-path set;
+    ``resync()`` (after a build) re-registers watches so directories
+    created between builds are covered going forward."""
+
+    def __init__(self, root: str, blacklist: list[str]) -> None:
+        self.root = root
+        self.blacklist = list(blacklist)
+        self.healthy = False
+        self._fd = -1
+        self._wd_paths: dict[int, str] = {}
+        self._needs_resync = False
+        self._libc = _libc()
+        if self._libc is None or not hasattr(self._libc,
+                                             "inotify_init1"):
+            return
+        fd = self._libc.inotify_init1(_IN_NONBLOCK | _IN_CLOEXEC)
+        if fd < 0:
+            return
+        self._fd = fd
+        self.healthy = self._add_watches()
+        if not self.healthy:
+            self.close()
+
+    def _dirs(self) -> list[str]:
+        """Directory list via a stat-free scandir descent (dirent type
+        bits only): registering watches over a 100k-file tree must not
+        pay a full per-file lstat walk."""
+        from makisu_tpu.utils import pathutils
+        dirs = [self.root]
+        stack = [self.root]
+        limit = max_watches()
+        try:
+            while stack:
+                cur = stack.pop()
+                with os.scandir(cur) as it:
+                    for entry in it:
+                        if not entry.is_dir(follow_symlinks=False):
+                            continue
+                        if pathutils.is_descendant_of_any(
+                                entry.path, self.blacklist):
+                            continue
+                        dirs.append(entry.path)
+                        if len(dirs) > limit:
+                            return dirs  # caller sees > cap and bails
+                        stack.append(entry.path)
+        except OSError:
+            return []
+        return dirs
+
+    def _add_watches(self) -> bool:
+        dirs = self._dirs()
+        if not dirs or len(dirs) > max_watches():
+            return False
+        for path in dirs:
+            wd = self._libc.inotify_add_watch(
+                self._fd, path.encode(), _WATCH_MASK)
+            if wd < 0:
+                return False  # ENOSPC / vanished dir: fall back whole
+            self._wd_paths[wd] = path
+        return True
+
+    def collect(self) -> set[str] | None:
+        """Drain events into dirty paths. ``None`` means knowledge was
+        lost (overflow, read error, structural staleness) — callers
+        must fall back to a full re-scan."""
+        if not self.healthy:
+            return None
+        dirty: set[str] = set()
+        structural = False
+        while True:
+            try:
+                buf = os.read(self._fd, 65536)
+            except BlockingIOError:
+                break
+            except OSError:
+                self.healthy = False
+                return None
+            if not buf:
+                break
+            off = 0
+            while off + _EVENT_HDR.size <= len(buf):
+                wd, mask, _cookie, nlen = _EVENT_HDR.unpack_from(
+                    buf, off)
+                name = buf[off + _EVENT_HDR.size:
+                           off + _EVENT_HDR.size + nlen].rstrip(b"\0")
+                off += _EVENT_HDR.size + nlen
+                if mask & _IN_Q_OVERFLOW:
+                    self.healthy = False
+                    return None
+                base = self._wd_paths.get(wd)
+                if mask & _IN_IGNORED:
+                    self._wd_paths.pop(wd, None)
+                    structural = True
+                    continue
+                if base is None:
+                    continue
+                path = (os.path.join(base, name.decode(
+                    errors="surrogateescape")) if name else base)
+                dirty.add(path)
+                if mask & (_IN_ISDIR | _IN_DELETE_SELF
+                           | _IN_MOVE_SELF):
+                    # A directory appeared/vanished/moved: its
+                    # subtree's future events are unreliable until
+                    # watches re-register (resync after the build).
+                    # The dir itself is dirty, which forces the
+                    # containing source to re-walk — correctness holds
+                    # without per-event watch surgery.
+                    structural = True
+        if structural:
+            self._needs_resync = True
+        return dirty
+
+    def resync(self) -> None:
+        """Re-register watches after structural churn (directory
+        create/delete/rename staled the wd→path map or left subtrees
+        unwatched). NO-OP on the steady path: without a structural
+        event no new directories can exist, so a stable tree pays
+        nothing per build — the per-build full-tree walk this replaces
+        was itself a warm-floor term at 100k files."""
+        if not self.healthy or not self._needs_resync:
+            return
+        for wd in list(self._wd_paths):
+            self._libc.inotify_rm_watch(self._fd, wd)
+        self._wd_paths.clear()
+        self._needs_resync = False
+        self.healthy = self._add_watches()
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = -1
+        self.healthy = False
+
+
+# -- the session ------------------------------------------------------------
+
+
+class BuildSession:
+    """One context's resident warm state. Single-writer: the manager
+    hands a session to at most one build at a time (concurrent builds
+    of the same context bypass with reason=busy)."""
+
+    def __init__(self, context_dir: str, identity: str) -> None:
+        self.context_dir = context_dir
+        self.identity = identity
+        self.isa = _isa_identity()
+        self.created_mono = time.monotonic()
+        self.last_used_mono = self.created_mono
+        self.builds = 0
+        self.hits = 0
+        self.busy = False
+        # Resident state.
+        self.content_ids = None  # adopted from the first BuildContext
+        self.scan_memo: dict[tuple[str, int],
+                             tuple[int, int, int]] = {}
+        # Applied-layer op streams keyed by (applied-chain, digest):
+        # valid only at the exact chain position they were recorded at
+        # (builder/node.py holds the correctness argument).
+        self.layer_replay: dict[tuple[str, str], list] = {}
+        self._layer_entry_count = 0
+        self.snapshot: walk_mod.TreeSnapshot | None = None
+        self.watcher: InotifyWatcher | None = None
+        self.pending_dirty: set[str] = set()
+        # True iff the dirty set provably covers every change since the
+        # last successful build; False forces a full re-scan.
+        self.exact = False
+        self._ignore_sig = None  # .dockerignore content hash
+        self._walk_blacklist: list[str] = []
+        # Whether arming expensive tracking (the full-walk baseline)
+        # is worth it: set per build from resident_process / repeat use.
+        self._resident_hint = False
+
+    # -- accounting --
+
+    def resident_bytes(self) -> int:
+        n = self._layer_entry_count * _BYTES_PER_LAYER_ENTRY
+        n += len(self.scan_memo) * _BYTES_PER_MEMO
+        if self.content_ids is not None:
+            n += (len(getattr(self.content_ids, "_entries", None) or ())
+                  * _BYTES_PER_CONTENT_ID)
+        if self.snapshot is not None:
+            n += self.snapshot.approx_bytes()
+        return n
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        return {
+            "context": self.context_dir,
+            "identity": self.identity,
+            "isa": self.isa,
+            "builds": self.builds,
+            "hits": self.hits,
+            "resident_bytes": self.resident_bytes(),
+            "layers_cached": len(self.layer_replay),
+            "scan_memo_entries": len(self.scan_memo),
+            "dirty_pending": len(self.pending_dirty),
+            "dirty_exact": self.exact,
+            "watcher": ("inotify" if self.watcher is not None
+                        and self.watcher.healthy else "mtime-walk"),
+            "age_seconds": round(now - self.created_mono, 3),
+            "idle_seconds": round(now - self.last_used_mono, 3),
+            "busy": self.busy,
+        }
+
+    # -- dirty tracking --
+
+    def _ignore_signature(self):
+        path = os.path.join(self.context_dir, ".dockerignore")
+        try:
+            with open(path, "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return None
+
+    def poll_changes(self) -> set[str]:
+        """Accumulate changes since the last poll/build into
+        ``pending_dirty`` and return the signature-confirmed NEW dirt
+        from this poll (what a watch loop triggers on). Watcher events
+        when healthy; one mtime-walk delta otherwise.
+
+        Knowledge loss — watcher overflow/death, a failed delta walk,
+        or no baseline at all — NEVER goes silent: the session turns
+        inexact, the whole context is flagged dirty once (so the next
+        build re-scans everything and a watch loop rebuilds), and a
+        fresh walk baseline is seeded so tracking resumes."""
+        if self.watcher is not None and self.watcher.healthy:
+            got = self.watcher.collect()
+            if got is not None:
+                self.pending_dirty |= got
+                # New dirs appeared? Register their watches BEFORE the
+                # caller scans, so edits inside them during the build
+                # are evented (no-op without structural churn).
+                self.watcher.resync()
+                if self.watcher.healthy:
+                    return got
+            # Overflow / read error / resync failure: the watcher is
+            # dead — release its fd + kernel watches (a long-lived
+            # worker must not pin inotify limits on corpses) and fall
+            # through to re-seed the walk baseline.
+            self.watcher.close()
+        if self.snapshot is not None:
+            try:
+                self.snapshot, delta = walk_mod.snapshot_delta(
+                    self.snapshot, self._walk_blacklist)
+            except OSError:
+                self.snapshot = None
+                self.exact = False
+                self.pending_dirty.add(self.context_dir)
+                return {self.context_dir}
+            self.pending_dirty |= delta.dirty
+            return delta.real_dirty
+        # No baseline: what changed since the last certified point is
+        # unknowable — flag everything once and re-baseline. The
+        # baseline walk (a full lstat pass) only runs when residency
+        # can pay it back: a resident process, or an in-process repeat
+        # build. A one-shot CLI build on a watcher-less host skips it
+        # — it would be a 100k-file walk armed for a process about to
+        # exit.
+        self.exact = False
+        self.pending_dirty.add(self.context_dir)
+        if self._resident_hint:
+            try:
+                self.snapshot = walk_mod.snapshot_tree(
+                    self.context_dir, self._walk_blacklist)
+            except OSError:
+                self.snapshot = None
+        return {self.context_dir}
+
+    # -- build lifecycle --
+
+    def begin_build(self, ctx, resident_process: bool = False) -> str:
+        """Arm ``ctx`` with this session's resident state. Returns the
+        warm mode this build runs under ("resident" | "rescan").
+        ``resident_process`` (worker / --watch) additionally defers
+        statcache persistence to a background thread — a one-shot CLI
+        process must keep the synchronous save or it may exit before
+        the write lands."""
+        self.builds += 1
+        self.last_used_mono = time.monotonic()
+        self._resident_hint = resident_process or self.builds >= 2
+        self._walk_blacklist = [
+            p for p in (list(ctx.base_blacklist)
+                        + [ctx.image_store.root])
+            if p != ctx.context_dir]
+        # The tracker must exist BEFORE this build's scan reads any
+        # file: an edit landing mid-build (after the scan passed it)
+        # must surface in the NEXT build's dirty set — watcher events
+        # queue in the kernel; the walk baseline below is captured
+        # pre-scan so the next delta re-examines anything that moved
+        # after it. A baseline taken after the build would absorb
+        # mid-build edits and replay a stale scan memo.
+        if self.watcher is None:
+            self.watcher = InotifyWatcher(self.context_dir,
+                                          self._walk_blacklist)
+            if not self.watcher.healthy:
+                self.watcher.close()
+        self.poll_changes()
+        # .dockerignore governs which paths enter cache identity but
+        # lives OUTSIDE the per-source subtrees, so the scan memo can't
+        # see it change through the dirty containment check — hash it
+        # every build and drop the memo on any change.
+        ignore_sig = self._ignore_signature()
+        if ignore_sig != self._ignore_sig:
+            if self._ignore_sig is not None or ignore_sig is not None:
+                self.scan_memo.clear()
+            self._ignore_sig = ignore_sig
+        # Adopt or install the resident content-ID cache.
+        if self.content_ids is None:
+            self.content_ids = ctx.content_ids
+        else:
+            ctx.content_ids = self.content_ids
+        begin = getattr(self.content_ids, "begin_build", None)
+        if begin is not None:
+            begin()
+        # Resident process: the statcache's disk copy is durability
+        # only — persist it off the build's critical path.
+        if resident_process:
+            self.content_ids.defer_save = True
+        mode = "resident" if self.exact else "rescan"
+        ctx.session = self
+        ctx.dirty_paths = frozenset(self.pending_dirty)
+        ctx.dirty_exact = self.exact
+        if self.exact:
+            self.hits += 1
+            metrics.counter_add(SESSION_HITS)
+        log.info("build session %s: mode=%s dirty=%d builds=%d",
+                 self.identity, mode, len(self.pending_dirty),
+                 self.builds)
+        return mode
+
+    def finish_build(self, ctx, ok: bool) -> None:
+        self.last_used_mono = time.monotonic()
+        if ok:
+            # Everything dirty was consumed by this build's scan.
+            self.pending_dirty.clear()
+            if self.watcher is not None and self.watcher.healthy:
+                # Mid-build edits are drained AND kept pending: the
+                # scan may have read a file before the racing write
+                # landed — one conservative extra re-hash, never a
+                # stale identity. Collect runs BEFORE resync so a
+                # raced structural event (new dir) triggers the watch
+                # rebuild.
+                raced = self.watcher.collect()
+                self.watcher.resync()
+                if raced is None or not self.watcher.healthy:
+                    # Watcher died at the finish line: the next
+                    # begin's poll flags the context and re-seeds a
+                    # walk baseline.
+                    self.watcher.close()
+                    self.snapshot = None
+                    self.exact = False
+                else:
+                    self.pending_dirty |= raced
+                    self.exact = True
+            else:
+                # mtime-walk fallback: the baseline captured at
+                # begin_build — BEFORE this build's scan — is the
+                # certification point; the next delta re-examines
+                # anything that moved after it, including mid-build
+                # edits.
+                self.exact = self.snapshot is not None
+        else:
+            # A failed build may have consumed part of the dirty set
+            # before dying; only a full re-scan re-certifies it.
+            self.exact = False
+            self.snapshot = None
+            self.pending_dirty.clear()
+            self.scan_memo.clear()
+        # The per-build context must not leak a dead session reference.
+        ctx.session = None
+        ctx.dirty_paths = frozenset()
+        ctx.dirty_exact = False
+
+    # -- memo surfaces (called via ctx by steps/memfs/node) --
+
+    def scan_lookup(self, source: str, checksum_in: int):
+        return self.scan_memo.get((source, checksum_in))
+
+    def scan_store(self, source: str, checksum_in: int,
+                   checksum_out: int, files: int, nbytes: int) -> None:
+        if len(self.scan_memo) >= _SCAN_MEMO_KEEP:
+            # Insertion-order eviction: stale (source, checksum) keys
+            # from superseded chains age out first.
+            self.scan_memo.pop(next(iter(self.scan_memo)))
+        self.scan_memo[(source, checksum_in)] = (
+            checksum_out, files, nbytes)
+
+    def replay_lookup(self, key: tuple[str, str]):
+        return self.layer_replay.get(key)
+
+    def replay_store(self, key: tuple[str, str],
+                     entries: list) -> None:
+        if key in self.layer_replay:
+            return
+        self.layer_replay[key] = entries
+        self._layer_entry_count += len(entries)
+
+    def evict_layers(self, keep_bytes: int) -> None:
+        """Drop oldest layer memos until resident bytes fit."""
+        while (self.layer_replay
+               and self.resident_bytes() > keep_bytes):
+            key, entries = next(iter(self.layer_replay.items()))
+            del self.layer_replay[key]
+            self._layer_entry_count -= len(entries)
+
+    def close(self) -> None:
+        if self.watcher is not None:
+            self.watcher.close()
+            self.watcher = None
+
+
+# -- the manager ------------------------------------------------------------
+
+
+class SessionManager:
+    """Process-wide session registry with TTL/LRU/byte-budget
+    eviction. One session per context path; acquire is non-blocking —
+    a second concurrent build of the same context bypasses residency
+    instead of serializing on it."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._sessions: dict[str, BuildSession] = {}
+        self.invalidations: dict[str, int] = {}
+
+    def _invalidate_locked(self, key: str, reason: str) -> None:
+        session = self._sessions.pop(key, None)
+        if session is None:
+            return
+        session.close()
+        self.invalidations[reason] = \
+            self.invalidations.get(reason, 0) + 1
+        metrics.counter_add(SESSION_INVALIDATIONS, reason=reason)
+        ledger.record("session", session.context_dir, "invalidated",
+                      reason=reason, builds=session.builds,
+                      resident_bytes=session.resident_bytes())
+        log.info("build session invalidated: %s (%s)",
+                 session.context_dir, reason)
+
+    def _publish_bytes_locked(self) -> None:
+        total = sum(s.resident_bytes()
+                    for s in self._sessions.values())
+        metrics.global_registry().gauge_set(SESSION_RESIDENT_BYTES,
+                                            total)
+
+    def acquire(self, context_dir: str,
+                identity: str) -> tuple["BuildSession | None", str]:
+        """Lease the context's session for one build. Returns
+        ``(session, verdict)`` where verdict is one of ``hit`` (a live
+        session was reused), ``miss`` (a new session was created), or
+        ``busy`` (another build holds it — caller proceeds without
+        residency)."""
+        context_dir = os.path.abspath(context_dir)
+        key = os.path.realpath(context_dir)
+        now = time.monotonic()
+        with self._mu:
+            session = self._sessions.get(key)
+            if session is not None:
+                if session.busy:
+                    return None, "busy"
+                if session.identity != identity:
+                    self._invalidate_locked(key, "flag_identity")
+                    session = None
+                elif session.isa != _isa_identity():
+                    self._invalidate_locked(key, "isa_change")
+                    session = None
+                elif now - session.last_used_mono > session_ttl():
+                    self._invalidate_locked(key, "ttl")
+                    session = None
+            verdict = "hit" if session is not None else "miss"
+            if session is None:
+                session = BuildSession(context_dir, identity)
+                self._sessions[key] = session
+                # Count-based LRU: evict the stalest idle session.
+                while len(self._sessions) > max(1, max_sessions()):
+                    victims = sorted(
+                        ((s.last_used_mono, k)
+                         for k, s in self._sessions.items()
+                         if k != key and not s.busy))
+                    if not victims:
+                        break
+                    self._invalidate_locked(victims[0][1], "lru")
+            session.busy = True
+            self._publish_bytes_locked()
+        return session, verdict
+
+    def release(self, session: BuildSession) -> None:
+        key = os.path.realpath(session.context_dir)
+        budget = max_resident_bytes()
+        with self._mu:
+            session.busy = False
+            # Byte budget: first shrink the releasing session's layer
+            # memo, then evict whole idle sessions oldest-first.
+            total = sum(s.resident_bytes()
+                        for s in self._sessions.values())
+            if total > budget:
+                session.evict_layers(
+                    max(0, budget - (total - session.resident_bytes())))
+            while (sum(s.resident_bytes()
+                       for s in self._sessions.values()) > budget
+                   and len(self._sessions) > 1):
+                victims = sorted(
+                    ((s.last_used_mono, k)
+                     for k, s in self._sessions.items()
+                     if k != key and not s.busy))
+                if not victims:
+                    break
+                self._invalidate_locked(victims[0][1], "lru")
+            self._publish_bytes_locked()
+
+    def peek(self, context_dir: str) -> "BuildSession | None":
+        """The context's live session, if any — no lease, no
+        invalidation checks (the watch loop polls change state through
+        it between builds)."""
+        key = os.path.realpath(os.path.abspath(context_dir))
+        with self._mu:
+            return self._sessions.get(key)
+
+    def invalidate(self, context_dir: str = "") -> int:
+        """Explicit invalidation (the worker's POST endpoint). Empty
+        context drops every non-busy session; returns the count."""
+        dropped = 0
+        with self._mu:
+            if context_dir:
+                keys = [os.path.realpath(os.path.abspath(context_dir))]
+            else:
+                keys = list(self._sessions)
+            for key in keys:
+                session = self._sessions.get(key)
+                if session is None or session.busy:
+                    continue
+                self._invalidate_locked(key, "explicit")
+                dropped += 1
+            self._publish_bytes_locked()
+        return dropped
+
+    def stats(self) -> dict:
+        """The ``/healthz`` sessions section + ``GET /sessions``."""
+        with self._mu:
+            sessions = [s.stats() for s in self._sessions.values()]
+            # Copied under the lock: a concurrent first-of-its-kind
+            # invalidation reason would otherwise mutate the dict mid-
+            # iteration and 500 a health probe.
+            invalidations = dict(self.invalidations)
+        sessions.sort(key=lambda s: s["context"])
+        return {
+            "count": len(sessions),
+            "resident_bytes": sum(s["resident_bytes"]
+                                  for s in sessions),
+            "hits": sum(s["hits"] for s in sessions),
+            "invalidations": dict(sorted(invalidations.items())),
+            "max_sessions": max_sessions(),
+            "max_resident_bytes": max_resident_bytes(),
+            "ttl_seconds": session_ttl(),
+            "sessions": sessions,
+        }
+
+    def reset(self) -> None:
+        """Drop everything (tests)."""
+        with self._mu:
+            for session in self._sessions.values():
+                session.close()
+            self._sessions.clear()
+            self.invalidations.clear()
+            self._publish_bytes_locked()
+
+
+_manager = SessionManager()
+
+
+def manager() -> SessionManager:
+    return _manager

@@ -1,0 +1,224 @@
+"""Dirty-set correctness under adversarial edits.
+
+The contract the resident session must never break: with sessions ON,
+every build's image digests are byte-identical to what the session-less
+path produces from the same storage state — the incremental engine may
+only skip work it can PROVE is unchanged. Two storage trees are warmed
+by identical build sequences (one with sessions, one without); after
+every adversarial edit both rebuild and the digests must match. Edits
+that change content must also be SEEN (digests move), guarding against
+the stale-skip failure mode.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from makisu_tpu import cli
+from makisu_tpu.docker.image import ImageName
+from makisu_tpu.storage import ImageStore
+from makisu_tpu.worker import session as session_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sessions(monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_STAT_CACHE_WINDOW_NS", "0")
+    session_mod.manager().reset()
+    yield
+    session_mod.manager().reset()
+
+
+class _Harness:
+    """Two builders over one context: `resident` (sessions on) and
+    `oracle` (MAKISU_TPU_SESSION=0), each with its own storage/KV."""
+
+    def __init__(self, tmp_path) -> None:
+        self.tmp = tmp_path
+        self.ctx = tmp_path / "ctx"
+        (self.ctx / "base").mkdir(parents=True)
+        (self.ctx / "src").mkdir()
+        (self.ctx / "Dockerfile").write_text(
+            "FROM scratch\nCOPY base/ /base/\nCOPY src/ /src/\n")
+        for i in range(6):
+            (self.ctx / "base" / f"b{i}.txt").write_text(
+                f"base {i}\n" * 20)
+            (self.ctx / "src" / f"s{i}.txt").write_text(
+                f"src {i}\n" * 20)
+        (tmp_path / "root").mkdir()
+        self.seq = 0
+
+    def _one(self, storage: str, sessions_on: bool) -> list[str]:
+        tag = f"ds/t:{self.seq}"
+        env_before = os.environ.get("MAKISU_TPU_SESSION")
+        if not sessions_on:
+            os.environ["MAKISU_TPU_SESSION"] = "0"
+        try:
+            code = cli.main([
+                "--log-level", "error", "build", str(self.ctx),
+                "-t", tag, "--hasher", "cpu",
+                "--storage", str(self.tmp / storage),
+                "--root", str(self.tmp / "root")])
+        finally:
+            if not sessions_on:
+                if env_before is None:
+                    os.environ.pop("MAKISU_TPU_SESSION", None)
+                else:
+                    os.environ["MAKISU_TPU_SESSION"] = env_before
+        assert code == 0
+        with ImageStore(str(self.tmp / storage)) as store:
+            manifest = store.manifests.load(ImageName.parse(tag))
+            return [l.digest.hex() for l in manifest.layers]
+
+    def build_both(self) -> tuple[list[str], list[str]]:
+        """Build resident + oracle; assert and return the digests."""
+        self.seq += 1
+        resident = self._one("storage-resident", True)
+        oracle = self._one("storage-oracle", False)
+        assert resident == oracle, (
+            "incremental digests diverged from the session-less path")
+        return resident, oracle
+
+    def session(self):
+        return session_mod.manager().peek(str(self.ctx))
+
+
+def test_adversarial_edit_matrix(tmp_path):
+    h = _Harness(tmp_path)
+    baseline, _ = h.build_both()
+    warm, _ = h.build_both()  # no edit: resident reuse, same digests
+    assert warm == baseline
+    session = h.session()
+    assert session is not None and session.hits >= 1
+
+    # 1. mtime-only touch: stat moves, content doesn't. Cache identity
+    # is content-based, so digests must NOT move — and both paths must
+    # agree on that.
+    victim = h.ctx / "src" / "s2.txt"
+    st = os.lstat(victim)
+    os.utime(victim, ns=(st.st_atime_ns + 7_000_000_000,
+                         st.st_mtime_ns + 7_000_000_000))
+    touched, _ = h.build_both()
+    assert touched == baseline
+
+    # 2. content change with the SAME size and a restored mtime (the
+    # racy aliasing attempt): ctime always bumps, so the edit must be
+    # seen — digests move, and both paths move identically.
+    st = os.lstat(victim)
+    original = victim.read_bytes()
+    flipped = bytes(reversed(original))
+    assert len(flipped) == len(original) and flipped != original
+    victim.write_bytes(flipped)
+    os.utime(victim, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert os.lstat(victim).st_size == st.st_size
+    assert os.lstat(victim).st_mtime_ns == st.st_mtime_ns
+    edited, _ = h.build_both()
+    assert edited != touched, "same-size/same-mtime edit was MISSED"
+
+    # 3a. delete of a mid-layer file.
+    (h.ctx / "src" / "s4.txt").unlink()
+    deleted, _ = h.build_both()
+    assert deleted != edited
+
+    # 3b. rename of a mid-layer file.
+    os.rename(h.ctx / "src" / "s5.txt", h.ctx / "src" / "s5-new.txt")
+    renamed, _ = h.build_both()
+    assert renamed != deleted
+
+    # 4. untouched-subtree skip is actually engaging: base/ was never
+    # edited, so its checksum transitions replay from the memo.
+    assert session.scan_memo, "scan memo never populated"
+
+    # 5. a new file appears.
+    (h.ctx / "src" / "brand-new.txt").write_text("fresh\n")
+    added, _ = h.build_both()
+    assert added != renamed
+
+
+def test_dockerignore_masked_edits(tmp_path):
+    h = _Harness(tmp_path)
+    (h.ctx / ".dockerignore").write_text("src/ignored.log\n")
+    (h.ctx / "src" / "ignored.log").write_text("noise 1\n")
+    baseline, _ = h.build_both()
+
+    # Editing an ignored file changes nothing: identical digests from
+    # both paths (the dirty set flags it; the re-walk proves it inert).
+    (h.ctx / "src" / "ignored.log").write_text("noise 2 louder\n")
+    masked, _ = h.build_both()
+    assert masked == baseline
+
+    # Changing .dockerignore itself IS identity-bearing: unmasking the
+    # file must change digests in both paths (the session drops its
+    # scan memo on the rules change rather than replaying stale
+    # transitions).
+    (h.ctx / ".dockerignore").write_text("# nothing ignored now\n")
+    unmasked, _ = h.build_both()
+    assert unmasked != baseline
+
+
+def test_dir_rename_above_source_invalidates_memo(tmp_path):
+    """Renaming an ANCESTOR of a COPY source emits watcher events only
+    for the moved directory itself — the dirty containment check must
+    treat a dirty ancestor as invalidating, or the scan memo replays a
+    checksum for a tree that no longer exists."""
+    ctx = tmp_path / "ctx"
+    (ctx / "outer" / "inner").mkdir(parents=True)
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY outer/inner/ /app/\n")
+    (ctx / "outer" / "inner" / "f.txt").write_text("original\n")
+    (tmp_path / "root").mkdir()
+    seq = [0]
+
+    def build(storage, sessions_on):
+        seq[0] += 1
+        tag = f"ren/t:{seq[0]}"
+        before = os.environ.get("MAKISU_TPU_SESSION")
+        if not sessions_on:
+            os.environ["MAKISU_TPU_SESSION"] = "0"
+        try:
+            assert cli.main([
+                "--log-level", "error", "build", str(ctx), "-t", tag,
+                "--hasher", "cpu",
+                "--storage", str(tmp_path / storage),
+                "--root", str(tmp_path / "root")]) == 0
+        finally:
+            if not sessions_on:
+                if before is None:
+                    os.environ.pop("MAKISU_TPU_SESSION", None)
+                else:
+                    os.environ["MAKISU_TPU_SESSION"] = before
+        with ImageStore(str(tmp_path / storage)) as store:
+            manifest = store.manifests.load(ImageName.parse(tag))
+            return [l.digest.hex() for l in manifest.layers]
+
+    def both():
+        resident = build("st-resident", True)
+        oracle = build("st-oracle", False)
+        assert resident == oracle
+        return resident
+
+    baseline = both()
+    warm = both()  # session now resident with a populated memo
+    assert warm == baseline
+    os.rename(ctx / "outer", ctx / "moved-away")
+    (ctx / "outer" / "inner").mkdir(parents=True)
+    (ctx / "outer" / "inner" / "f.txt").write_text("replaced\n")
+    renamed = both()
+    assert renamed != baseline, \
+        "ancestor rename was invisible: stale scan memo replayed"
+
+
+def test_session_survives_deleted_then_recreated_tree(tmp_path):
+    """Torching the whole context between builds must not wedge or
+    stale the session — worst-case structural churn."""
+    h = _Harness(tmp_path)
+    baseline, _ = h.build_both()
+    src = h.ctx / "src"
+    shutil.rmtree(src)
+    src.mkdir()
+    for i in range(3):
+        (src / f"n{i}.txt").write_text(f"regenerated {i}\n")
+    rebuilt, _ = h.build_both()
+    assert rebuilt != baseline
+    again, _ = h.build_both()
+    assert again == rebuilt

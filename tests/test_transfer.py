@@ -239,7 +239,7 @@ class _RecorderFS:
     def __init__(self):
         self.applied = []
 
-    def update_from_tar(self, tf, untar=False):
+    def update_from_tar(self, tf, untar=False, chain_key=None):
         self.applied.append(tf.getnames()[0])
 
 
