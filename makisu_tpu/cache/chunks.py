@@ -71,6 +71,54 @@ def pack_target_bytes() -> int:
     return max(target, 1_000_000)
 
 
+# -- process-wide serving registry (the worker's GET /chunks/<fp>) ----------
+
+# Every ChunkStore attached in this process, keyed by its CAS root: the
+# worker's read-only peer-exchange endpoint serves chunk bytes out of
+# whichever store holds them. Bounded by the number of distinct storage
+# roots the process has built against (a worker typically has one);
+# re-attaching a root replaces the entry, so the registry never grows
+# with build count.
+import threading as _threading
+
+_serving_stores: dict[str, "ChunkStore"] = {}
+_serving_lock = _threading.Lock()
+
+
+def register_serving_store(store: "ChunkStore") -> None:
+    key = os.path.realpath(store.cas.root)
+    with _serving_lock:
+        _serving_stores[key] = store
+
+
+def serving_stores() -> list["ChunkStore"]:
+    with _serving_lock:
+        return list(_serving_stores.values())
+
+
+def open_served_chunk(hex_digest: str, roots=None):
+    """Open ``hex_digest`` from a registered store (the worker's
+    ``GET /chunks/<fp>`` backend): returns an open file object or None.
+    Local CAS only — serving a peer must never trigger our OWN remote
+    fetch (a fleet of workers each proxying the miss onward would
+    amplify one cold chunk into N registry round trips).
+
+    ``roots`` (realpath'd CAS roots) scopes the lookup to the stores a
+    particular worker actually owns: in an in-process fleet the
+    registry is shared by every worker, and serving a sibling's bytes
+    would fake the cross-host exchange the endpoint models (the same
+    per-machine honesty the per-server session managers give)."""
+    for store in serving_stores():
+        if roots is not None \
+                and os.path.realpath(store.cas.root) not in roots:
+            continue
+        try:
+            return store.cas.open(hex_digest)
+        except FileNotFoundError:
+            continue
+    return None
+
+
 def _skip(stream, nbytes: int) -> None:
     """Advance a non-seekable decompression stream by nbytes."""
     while nbytes > 0:
@@ -488,6 +536,25 @@ class ChunkStore:
 
         if not missing:
             return outcome(True)
+        # Peer exchange first: a fleet sibling that built this (or any
+        # chunk-sharing) context holds the bytes one unix-socket round
+        # trip away — the registry is a WAN away and the KV blob plane
+        # may not even be attached. Budget-charged through the transfer
+        # engine like every other wire path. No peers configured: free
+        # no-op.
+        from makisu_tpu.fleet import peers as fleet_peers
+        if fleet_peers.available():
+            from_peers = fleet_peers.fetch_chunks(self.put, missing,
+                                                  lengths)
+            if from_peers:
+                events.emit("chunk_fetch", route="peer",
+                            fetched=len(from_peers),
+                            requested=len(missing))
+                log.info("fetched %d/%d missing chunks from fleet "
+                         "peers", len(from_peers), len(missing))
+                missing = [h for h in missing if h not in from_peers]
+            if not missing:
+                return outcome(True)
         if self.registry is None:
             return outcome(False)
         if packs:
@@ -930,6 +997,9 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
     manager has a registry client, chunks also distribute through the
     registry blob plane."""
     chunk_store = ChunkStore(chunk_root)
+    # Peer-exchange serving side: this store's chunks become fetchable
+    # by fleet siblings through the worker's GET /chunks/<fp>.
+    register_serving_store(chunk_store)
     if getattr(manager, "registry", None) is not None:
         chunk_store.set_remote(manager.registry)
     inner_push = manager.push_cache

@@ -225,8 +225,49 @@ def make_parser() -> argparse.ArgumentParser:
                              "0 = unlimited (default; env "
                              "MAKISU_TPU_MAX_CONCURRENT_BUILDS)")
 
+    fleet = sub.add_parser(
+        "fleet", help="run the build-farm front door: route builds "
+                      "across N workers by session affinity")
+    fleet.add_argument("--socket",
+                       default="/tmp/makisu-tpu-fleet.sock",
+                       help="unix socket the front door listens on "
+                            "(speaks the worker protocol — existing "
+                            "clients/top/loadgen point here "
+                            "unchanged)")
+    fleet.add_argument("--worker", action="append", default=[],
+                       metavar="SOCKET[=STORAGE]",
+                       help="one fleet member's worker socket "
+                            "(repeat per worker); an optional "
+                            "=STORAGE overrides --storage on builds "
+                            "forwarded to it (in-process fleets "
+                            "modeling per-machine disks)")
+    fleet.add_argument("--poll-interval", type=float, default=1.0,
+                       metavar="SECONDS",
+                       help="worker /healthz + /sessions poll cadence "
+                            "(the affinity/liveness signal)")
+    fleet.add_argument("--tenant-quota", type=int, default=0,
+                       metavar="N",
+                       help="per-tenant in-flight build quota at the "
+                            "front door; excess builds wait (FIFO) "
+                            "and the wait is recorded as a "
+                            "quota_denied fleet decision "
+                            "(0 = unlimited)")
+    fleet.add_argument("--max-inflight-builds", type=int, default=0,
+                       metavar="N",
+                       help="fleet-wide in-flight cap across all "
+                            "tenants (queue-depth backpressure on "
+                            "top of the workers' own admission "
+                            "queues; 0 = unlimited)")
+    fleet.add_argument("--spillover-queue-depth", type=int, default=2,
+                       metavar="N",
+                       help="load score (queue depth + in-flight) at "
+                            "which the consistent-hash owner of a "
+                            "new context is passed over for the "
+                            "least-loaded worker")
+
     top = sub.add_parser(
-        "top", help="live terminal view of a worker's builds")
+        "top", help="live terminal view of a worker's (or fleet "
+                    "front door's) builds")
     top.add_argument("--socket", default="/tmp/makisu-tpu-worker.sock",
                      help="worker unix socket to poll")
     top.add_argument("--interval", type=float, default=2.0,
@@ -286,6 +327,32 @@ def make_parser() -> argparse.ArgumentParser:
                          metavar="SECONDS",
                          help="how long to wait for the worker's "
                               "/ready")
+    loadgen.add_argument("--fleet", action="store_true",
+                         help="fleet mode: spawn --workers in-process "
+                              "workers behind the front-door "
+                              "scheduler (plus a shared cache KV and "
+                              "a single-worker baseline), drive "
+                              "repeated same-context builds through "
+                              "it, and report per-worker build "
+                              "distribution, affinity hit-rate, "
+                              "p99-vs-single-worker delta, drain-"
+                              "driven peer chunk exchange, and a "
+                              "mid-run worker kill's failover")
+    loadgen.add_argument("--workers", type=int, default=3,
+                         metavar="N",
+                         help="fleet mode: in-process workers behind "
+                              "the scheduler")
+    loadgen.add_argument("--tenant-quota", type=int, default=1,
+                         metavar="N",
+                         help="fleet mode: per-tenant in-flight "
+                              "quota at the front door (0 disables "
+                              "the quota-enforcement phase)")
+    loadgen.add_argument("--rounds", type=int, default=0,
+                         metavar="R",
+                         help="fleet mode: builds per context "
+                              "(default 3; >= 3 so the warmup, "
+                              "drain, and kill phases each get a "
+                              "round)")
 
     history = sub.add_parser(
         "history", help="render build-history trends, or `history "
@@ -964,6 +1031,44 @@ def cmd_worker(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Run the build-farm front door: a scheduler that fronts N
+    workers, routing each build to the worker holding its resident
+    session (affinity), placing new contexts by consistent hash with
+    least-loaded spillover, enforcing per-tenant quotas, failing over
+    past dead/refusing workers, and publishing the peer map workers
+    use to fetch chunks from each other before the registry."""
+    import contextvars
+
+    from makisu_tpu.fleet import FleetServer, WorkerSpec
+    if not args.worker:
+        raise SystemExit("fleet needs at least one "
+                         "--worker SOCKET[=STORAGE]")
+    specs = [WorkerSpec.parse(flag, i)
+             for i, flag in enumerate(args.worker)]
+    server = FleetServer(
+        args.socket, specs,
+        poll_interval=args.poll_interval,
+        tenant_quota=args.tenant_quota,
+        max_inflight=args.max_inflight_builds,
+        spillover_queue_depth=args.spillover_queue_depth,
+        # Scheduler decisions (source=fleet) reach THIS invocation's
+        # --events-out/--explain-out sinks: handler threads have no
+        # bound context, so the scheduler replays emissions under the
+        # context captured here.
+        event_context=contextvars.copy_context())
+    log.info("fleet front door listening on %s (%d workers: %s)",
+             args.socket, len(specs),
+             ", ".join(s.socket_path for s in specs))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live terminal view of a worker: in-flight builds (tenant,
     phase, progress age, queue wait, cache hit rate), the admission
@@ -1041,9 +1146,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
                 "diff": cmd_diff, "worker": cmd_worker,
-                "report": cmd_report, "doctor": cmd_doctor,
-                "explain": cmd_explain, "top": cmd_top,
-                "loadgen": cmd_loadgen, "history": cmd_history}
+                "fleet": cmd_fleet, "report": cmd_report,
+                "doctor": cmd_doctor, "explain": cmd_explain,
+                "top": cmd_top, "loadgen": cmd_loadgen,
+                "history": cmd_history}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -1143,11 +1249,12 @@ def main(argv: list[str] | None = None) -> int:
     # live stream. The `worker` command is exempt: a per-invocation
     # watchdog has no active_fn gate and would flag a healthy IDLE
     # worker as stalled — cmd_worker's server arms its own, gated on
-    # in-flight builds.
+    # in-flight builds. The `fleet` front door is exempt for the same
+    # reason (long-lived, legitimately idle between submissions).
     watchdog = None
     stall_timeout = (args.stall_timeout or
                      flightrecorder.stall_timeout_from_env())
-    if stall_timeout > 0 and args.command != "worker":
+    if stall_timeout > 0 and args.command not in ("worker", "fleet"):
         watchdog = flightrecorder.StallWatchdog(
             stall_timeout, recorder,
             flightrecorder.forced_bundle_path(

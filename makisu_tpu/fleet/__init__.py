@@ -1,0 +1,28 @@
+"""Build-farm front door: fleet scheduler + worker registry + peers.
+
+ROADMAP item 1. The worker (makisu_tpu/worker/) stayed one process for
+ten PRs; this package turns N of them into a fleet:
+
+- ``scheduler.py`` — the routing core: session-affinity first (a build
+  landing on the worker holding its resident session gets the ~1.15s
+  warm rebuild; anywhere else pays the cold path), consistent-hash
+  placement for new contexts, least-loaded spillover past a queue-depth
+  threshold, per-tenant in-flight quotas, and failover when a worker is
+  unreachable or refuses admission.
+- ``server.py`` — the HTTP front door. It speaks the worker's own
+  protocol over a unix socket, so every existing client (WorkerClient,
+  ``makisu-tpu top``, loadgen) points at the fleet socket unchanged.
+- ``peers.py`` — the peer chunk-exchange map the scheduler publishes:
+  a worker missing a chunk consults its peers' ``GET /chunks/<fp>``
+  (budget-charged through the transfer engine) before paying the
+  registry. Deliberately minimal — the blob-CAS/chunk-CAS/pack
+  content-store unification is its own future PR (ROADMAP).
+- ``kv.py`` — a shared cache-KV endpoint (the HTTPStore wire protocol)
+  for fleet harnesses: loadgen/CI give every worker one cache plane so
+  cross-worker cache hits (and therefore peer chunk fetches) are real.
+"""
+
+from makisu_tpu.fleet.scheduler import FleetScheduler, WorkerSpec
+from makisu_tpu.fleet.server import FleetServer
+
+__all__ = ["FleetScheduler", "FleetServer", "WorkerSpec"]

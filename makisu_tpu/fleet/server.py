@@ -1,0 +1,521 @@
+"""Fleet front door: the worker protocol, fronting N workers.
+
+The server speaks the same unix-socket HTTP surface as a single worker
+(``/ready``, ``/build``, ``/healthz``, ``/builds``, ``/metrics``,
+``/exit``), so every existing consumer — :class:`WorkerClient`,
+``makisu-tpu top``, loadgen, CI scripts — points at the fleet socket
+unchanged. On top of that it adds the fleet-only surface:
+
+- ``GET /fleet`` — the scheduler's full routing table: per-worker
+  state, sticky placements, tenant quotas, recent decisions.
+- ``GET /peers`` — the current peer map (also pushed to workers).
+- ``POST /drain`` — ``{"worker": ID[, "undrain": true]}``: graceful
+  drain (new builds route elsewhere; the worker stays up serving its
+  in-flight builds and peer chunk fetches).
+
+``POST /build`` is the routing path: admission (tenant quota +
+fleet-wide cap) at the front door, then route → forward → stream the
+worker's NDJSON frames through verbatim. The terminal frame is
+augmented with ``worker``, ``fleet_verdict``, ``fleet_attempts`` and
+``quota_wait_seconds`` so clients (and loadgen's fleet report) never
+parse logs for routing outcomes. A worker that is unreachable, refuses
+admission (the no-wait 503), or dies mid-stream is excluded and the
+build retries on the next-best worker — log frames already forwarded
+are not un-sent (duplicated lines are the documented cost of a
+mid-stream failover; the terminal frame is emitted exactly once).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import json
+import os
+import socketserver
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+from makisu_tpu.fleet.scheduler import (
+    FleetScheduler,
+    NoWorkersError,
+    WorkerSpec,
+    build_identity,
+)
+from makisu_tpu.utils import logging as log
+from makisu_tpu.utils import metrics
+
+# Attempts per build across distinct workers (initial + failovers).
+MAX_ATTEMPTS = 3
+
+# Read timeout for one worker's build stream: frames are heartbeat-ish
+# (logs, events); a worker silent this long is wedged and the build is
+# better restarted elsewhere. Generous — a 100k-file commit can be
+# quiet for a while between frames.
+STREAM_READ_TIMEOUT = 900.0
+
+_LATENCY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                    120.0, 300.0, 600.0, 1800.0)
+
+
+def rewrite_storage(argv: list[str], storage: str) -> list[str]:
+    """Rewrite/append ``--storage`` so the build lands on the routed
+    worker's own storage (the per-worker override an in-process fleet
+    uses to model per-machine disks). Handles both ``--storage PATH``
+    and ``--storage=PATH`` spellings."""
+    out = list(argv)
+    for i, arg in enumerate(out):
+        if arg == "--storage" and i + 1 < len(out):
+            out[i + 1] = storage
+            return out
+        if arg.startswith("--storage="):
+            out[i] = f"--storage={storage}"
+            return out
+    return out + ["--storage", storage]
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        server: FleetServer = self.server
+        if self.path == "/ready":
+            ok = any(w.alive for w in
+                     server.scheduler.workers.values())
+            self._respond(200 if ok else 503,
+                          b"ok" if ok else b"no workers alive")
+        elif self.path == "/metrics":
+            self._respond(
+                200, metrics.render_prometheus().encode(),
+                content_type="text/plain; version=0.0.4; "
+                             "charset=utf-8")
+        elif self.path == "/healthz":
+            self._respond(200, json.dumps(server.health()).encode(),
+                          content_type="application/json")
+        elif self.path == "/builds":
+            self._respond(200, json.dumps(server.builds()).encode(),
+                          content_type="application/json")
+        elif self.path == "/fleet":
+            self._respond(200,
+                          json.dumps(server.scheduler.stats()).encode(),
+                          content_type="application/json")
+        elif self.path == "/peers":
+            stats = server.scheduler.stats()
+            self._respond(200, json.dumps({
+                "version": stats["peer_map_version"],
+                "peers": [w["socket"] for w in stats["workers"]
+                          if w["alive"]],
+            }).encode(), content_type="application/json")
+        elif self.path == "/exit":
+            threading.Thread(target=server.shutdown,
+                             daemon=True).start()
+            self._respond(200, b"bye")
+        else:
+            self._respond(404, b"not found")
+
+    # -- POST --------------------------------------------------------------
+
+    def do_POST(self) -> None:
+        if self.path == "/drain":
+            self._handle_drain()
+        elif self.path == "/build":
+            self._handle_build()
+        else:
+            self._respond(404, b"not found")
+
+    def _handle_drain(self) -> None:
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            body = json.loads(self.rfile.read(length)) or {}
+            worker_id = str(body["worker"])
+            draining = not body.get("undrain", False)
+        except (ValueError, KeyError, TypeError):
+            self._respond(400, b'bad drain json (need {"worker": ID})')
+            return
+        if not self.server.scheduler.drain(worker_id, draining):
+            self._respond(404, b"unknown worker")
+            return
+        self._respond(200, json.dumps(
+            {"worker": worker_id, "draining": draining}).encode(),
+            content_type="application/json")
+
+    def _handle_build(self) -> None:
+        server: FleetServer = self.server
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            body = json.loads(self.rfile.read(length))
+        except ValueError:
+            self._respond(400, b"bad argv json")
+            return
+        tenant = ""
+        if isinstance(body, dict):
+            argv = body.get("argv") or []
+            tenant = str(body.get("tenant") or "")
+        else:
+            argv = body
+        tenant = self.headers.get("X-Makisu-Tenant") or tenant
+        if not isinstance(argv, list) or not all(
+                isinstance(a, str) for a in argv):
+            self._respond(400, b"bad argv json")
+            return
+
+        self.send_response(200)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        emit_lock = threading.Lock()
+        finished = threading.Event()
+
+        def emit(line: str) -> None:
+            data = (line.rstrip("\n") + "\n").encode()
+            frame = f"{len(data):x}\r\n".encode() + data + b"\r\n"
+            with emit_lock:
+                if finished.is_set():
+                    return
+                try:
+                    self.wfile.write(frame)
+                except (BrokenPipeError, ConnectionResetError):
+                    finished.set()  # client gone; keep the build going
+
+        try:
+            server.route_build(argv, tenant, emit)
+        finally:
+            with emit_lock:
+                if not finished.is_set():
+                    finished.set()
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+    def _respond(self, status: int, body: bytes,
+                 content_type: str | None = None) -> None:
+        try:
+            self.send_response(status)
+            if content_type:
+                self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class FleetServer(socketserver.ThreadingMixIn,
+                  socketserver.UnixStreamServer):
+    """The front door process: HTTP surface + scheduler + forwarder."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, socket_path: str, specs: list[WorkerSpec],
+                 poll_interval: float = 1.0,
+                 tenant_quota: int = 0,
+                 max_inflight: int = 0,
+                 spillover_queue_depth: int = 2,
+                 max_attempts: int = MAX_ATTEMPTS,
+                 event_context: "contextvars.Context | None" = None,
+                 ) -> None:
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        super().__init__(socket_path, _FleetHandler)
+        self.socket_path = socket_path
+        self.max_attempts = max(int(max_attempts), 1)
+        self.scheduler = FleetScheduler(
+            specs, poll_interval=poll_interval,
+            tenant_quota=tenant_quota, max_inflight=max_inflight,
+            spillover_queue_depth=spillover_queue_depth,
+            event_context=event_context)
+        self._started_mono = time.monotonic()
+        self._mu = threading.Lock()
+        self._seq = 0
+        self._pending: dict[int, dict] = {}
+        self._done_ok = 0
+        self._done_failed = 0
+        self._latencies: collections.deque[float] = collections.deque(
+            maxlen=512)
+        self.scheduler.start()
+
+    def get_request(self):
+        request, _ = super().get_request()
+        return request, ("fleet", 0)
+
+    def handle_error(self, request, client_address) -> None:
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return  # client hung up; normal churn
+        super().handle_error(request, client_address)
+
+    def serve_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def server_close(self) -> None:
+        self.scheduler.stop()
+        super().server_close()
+
+    # -- the routing/forwarding path ---------------------------------------
+
+    def route_build(self, argv: list[str], tenant: str, emit) -> int:
+        """Admit, route, forward, failover. ``emit(line)`` streams
+        NDJSON frames to the submitting client; the terminal frame is
+        always emitted exactly once (a synthesized failure frame when
+        every attempt is exhausted)."""
+        t0 = time.monotonic()
+        context_key, command = build_identity(argv)
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = {
+                "id": seq, "tenant": tenant, "state": "admitting",
+                "context": context_key, "command": command,
+                "worker": "", "enqueued_mono": t0,
+            }
+        scheduler = self.scheduler
+        quota_wait = scheduler.admit(tenant, context_key)
+        exclude: set[str] = set()
+        exit_code = 1
+        terminal_sent = False
+        try:
+            for attempt in range(self.max_attempts):
+                try:
+                    worker, verdict, reason = scheduler.route(
+                        context_key, tenant, exclude=exclude,
+                        attempt=attempt)
+                except NoWorkersError as e:
+                    emit(json.dumps({"level": "error", "msg": str(e)}))
+                    break
+                with self._mu:
+                    row = self._pending.get(seq)
+                    if row is not None:
+                        row.update(state="forwarded",
+                                   worker=worker.spec.id,
+                                   verdict=verdict)
+                forward_argv = argv
+                if worker.spec.storage:
+                    forward_argv = rewrite_storage(argv,
+                                                   worker.spec.storage)
+                # No-wait admission only when a refusal still has
+                # somewhere ELIGIBLE to go (dead/draining workers are
+                # not alternatives), never for an affinity route —
+                # waiting at the session holder (~1.15s warm rebuild)
+                # beats a cold build elsewhere by ~50x — and never on
+                # the LAST attempt: a fully saturated fleet must end
+                # with the build queueing somewhere, not with every
+                # worker having politely refused it.
+                no_wait = (verdict != "affinity"
+                           and attempt + 1 < self.max_attempts
+                           and scheduler.eligible_count(
+                               exclude | {worker.spec.id}) >= 1)
+                outcome, code = self._forward(
+                    worker, forward_argv, tenant, emit, no_wait,
+                    terminal_extra={
+                        "worker": worker.spec.id,
+                        "fleet_verdict": verdict,
+                        "fleet_reason": reason,
+                        "fleet_attempts": attempt + 1,
+                        "quota_wait_seconds": round(quota_wait, 3),
+                    })
+                if outcome == "done":
+                    scheduler.note_build_done(worker.spec.id)
+                    exit_code = code
+                    terminal_sent = True
+                    return code
+                scheduler.note_worker_failure(worker.spec.id, outcome)
+                exclude.add(worker.spec.id)
+                log.warning("fleet: build attempt %d on %s failed "
+                            "(%s); failing over", attempt + 1,
+                            worker.spec.id, outcome)
+            return exit_code
+        finally:
+            if not terminal_sent:
+                emit(json.dumps({
+                    "build_code": str(exit_code),
+                    "exit_code": exit_code,
+                    "error": "fleet: no worker could run this build",
+                    "elapsed_seconds": round(time.monotonic() - t0, 3),
+                    "quota_wait_seconds": round(quota_wait, 3),
+                    "tenant": tenant,
+                }))
+            scheduler.release(tenant)
+            latency = time.monotonic() - t0
+            with self._mu:
+                self._pending.pop(seq, None)
+                if exit_code == 0:
+                    self._done_ok += 1
+                else:
+                    self._done_failed += 1
+                self._latencies.append(latency)
+            metrics.global_registry().observe(
+                metrics.FLEET_BUILD_LATENCY, latency,
+                buckets=_LATENCY_BUCKETS,
+                tenant=scheduler.tenant_label(tenant))
+
+    def _forward(self, worker, argv: list[str], tenant: str, emit,
+                 no_wait: bool, terminal_extra: dict,
+                 ) -> tuple[str, int]:
+        """One attempt against one worker. Returns ``(outcome, code)``
+        where outcome is ``done`` (terminal frame relayed), or the
+        failover reason: ``unreachable`` | ``refused`` |
+        ``midstream``."""
+        import http.client as http_client
+
+        from makisu_tpu.worker.client import _UnixHTTPConnection
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-Makisu-Tenant"] = tenant
+        if no_wait:
+            headers["X-Makisu-No-Wait"] = "1"
+        conn = _UnixHTTPConnection(worker.spec.socket_path,
+                                   STREAM_READ_TIMEOUT,
+                                   connect_timeout=5.0)
+        try:
+            try:
+                conn.request("POST", "/build",
+                             body=json.dumps(argv).encode(),
+                             headers=headers)
+                resp = conn.getresponse()
+            except (OSError, http_client.HTTPException):
+                return "unreachable", 1
+            if resp.status == 503:
+                resp.read()
+                return "refused", 1
+            if resp.status != 200:
+                # The worker answered but can't run this (bad argv
+                # would 400 on every worker): relay as a failure, no
+                # failover churn.
+                emit(json.dumps({
+                    "level": "error",
+                    "msg": f"worker {worker.spec.id} rejected build: "
+                           f"HTTP {resp.status}"}))
+                emit(json.dumps({"build_code": "1", "exit_code": 1,
+                                 **terminal_extra}))
+                return "done", 1
+            from makisu_tpu.worker.client import (
+                iter_stream_lines,
+                terminal_exit_code,
+            )
+            try:
+                # One framing loop shared with WorkerClient.build
+                # (iter_stream_lines) — the forwarder only json-parses
+                # candidate TERMINAL lines; everything else passes
+                # through verbatim.
+                for line in iter_stream_lines(resp):
+                    payload = None
+                    if b'"build_code"' in line:
+                        try:
+                            payload = json.loads(line)
+                        except ValueError:
+                            payload = None
+                    if payload is not None \
+                            and "build_code" in payload:
+                        payload.update(terminal_extra)
+                        emit(json.dumps(payload))
+                        return "done", terminal_exit_code(payload)
+                    emit(line.decode(errors="replace"))
+                # EOF without a terminal frame: the worker died.
+                return "midstream", 1
+            except (OSError, http_client.HTTPException):
+                # A SIGKILLed worker surfaces as IncompleteRead (an
+                # HTTPException, not an OSError) on a chunked stream.
+                return "midstream", 1
+        finally:
+            conn.close()
+
+    # -- introspection -----------------------------------------------------
+
+    def health(self) -> dict:
+        """Worker-shaped ``/healthz`` (so ``top`` and WorkerClient
+        work against the fleet socket) plus the ``fleet`` section."""
+        stats = self.scheduler.stats()
+        with self._mu:
+            pending = len(self._pending)
+            ok, failed = self._done_ok, self._done_failed
+            latencies = list(self._latencies)
+        alive = [w for w in stats["workers"] if w["alive"]]
+        return {
+            "status": "ok" if alive else "degraded",
+            "role": "fleet",
+            "uptime_seconds": round(
+                time.monotonic() - self._started_mono, 3),
+            "builds_started": ok + failed + pending,
+            "builds_succeeded": ok,
+            "builds_failed": failed,
+            "active_builds": pending,
+            "queue": {
+                "depth": stats["frontdoor_waiting"],
+                "max_concurrent_builds": 0,
+                "wait_seconds": {},
+                "latency_seconds": metrics.percentile_stats(latencies),
+                "tenant_latency_seconds": {},
+            },
+            "fleet": stats,
+        }
+
+    def builds(self) -> dict:
+        """Aggregated ``GET /builds``: every alive worker's view, rows
+        tagged with the worker id, plus the front door's own pending
+        (admitting/forwarded) rows. The per-worker GETs fan out in
+        parallel: one slow-but-connectable worker must cost the
+        aggregate its OWN timeout, not a serial sum that freezes every
+        ``top`` poller."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from makisu_tpu.worker.client import WorkerClient
+        stats = self.scheduler.stats()
+        alive = [w for w in stats["workers"] if w["alive"]]
+        inflight: list[dict] = []
+        recent: list[dict] = []
+
+        def fetch(w):
+            client = WorkerClient(w["socket"], connect_timeout=2.0,
+                                  control_timeout=5.0, retries=0)
+            try:
+                return w, client.builds()
+            except (OSError, RuntimeError, ValueError):
+                return w, None
+
+        if alive:
+            with ThreadPoolExecutor(min(8, len(alive))) as pool:
+                fetched = list(pool.map(fetch, alive))
+        else:
+            fetched = []
+        for w, payload in fetched:
+            if payload is None:
+                continue
+            for row in payload.get("inflight", []):
+                row = dict(row)
+                row["worker"] = w["id"]
+                inflight.append(row)
+            for row in payload.get("recent", []):
+                row = dict(row)
+                row["worker"] = w["id"]
+                recent.append(row)
+        now = time.monotonic()
+        with self._mu:
+            pending_rows = [
+                {"id": -row["id"], "worker": row["worker"] or "-",
+                 "tenant": row["tenant"], "state": row["state"],
+                 "command": row["command"],
+                 "tag": os.path.basename(row["context"] or ""),
+                 "queue_wait_seconds": round(
+                     now - row["enqueued_mono"], 3),
+                 "age_seconds": round(now - row["enqueued_mono"], 3),
+                 "progress_age_seconds": 0.0, "cache": {}}
+                for row in self._pending.values()
+                if row["state"] == "admitting"]
+        # Workers already serve `recent` newest-first; keep their
+        # relative order under the merge (no cross-worker clock to
+        # sort by).
+        return {
+            "queue_depth": stats["frontdoor_waiting"],
+            "max_concurrent_builds": 0,
+            "inflight": pending_rows + inflight,
+            "recent": recent[:32],
+        }

@@ -77,7 +77,12 @@ class MemoryBudget:
     reservation fits; a single reservation larger than the whole budget
     is admitted only alone (it must not deadlock, and refusing it would
     turn an oversized blob into a build failure instead of a serial
-    transfer)."""
+    transfer). Deliberately BARGING (condition wait, no arrival
+    ordering): a small part must be admittable past a blocked oversized
+    reservation, or transfer throughput would head-of-line block — the
+    fleet front door, which needs the opposite (FIFO fairness over
+    admission slots), uses its own gate (fleet/scheduler._SlotGate)
+    instead of this class."""
 
     def __init__(self, limit: int) -> None:
         self.limit = max(int(limit), 1)

@@ -27,10 +27,38 @@ queue-wait vs execution split, per-tenant latency digests and the
 fairness ratio (max tenant p99 ÷ min tenant p99), HashService batch
 occupancy scraped from ``/metrics``, and the trajectory. Exit code is
 nonzero when any build failed.
+
+``--fleet`` switches to the fleet topology (ROADMAP item 1's
+acceptance harness): ``--workers N`` in-process workers — each with
+its own storage (a machine's local disk) and resident-session manager
+— behind the front-door scheduler, sharing one cache-KV plane
+(``fleet/kv.py``). The run first takes a single-worker BASELINE at
+equal load (fresh contexts/storage/KV, so nothing warms the fleet
+phase), then drives R rounds of the same K contexts through the
+scheduler with a per-round barrier:
+
+- round 0 cold, round 1 edited+warm (affinity routes back to each
+  context's session holder);
+- between rounds 1 and 2, the worker holding context 0 is DRAINED
+  (alive, routing off) and a second worker is KILLED outright;
+- round 2 rebuilds unchanged content: the drained worker's contexts
+  relocate and peer-fetch their chunks worker-to-worker
+  (``makisu_fleet_peer_chunk_hits_total``), the killed worker's
+  contexts complete via failover, and every relocated build's layer
+  digests must equal its round-1 digests byte for byte.
+
+The fleet report section carries the per-worker build distribution,
+affinity hit-rate (overall, and over builds whose session holder was
+still eligible), verdict tallies, quota enforcement counts, peer
+chunk-exchange counters, digest-identity verdicts, and the
+p99-vs-single-worker delta. Exit code is nonzero on any failed build
+or digest divergence.
 """
 
 from __future__ import annotations
 
+import http.client
+import json
 import os
 import re
 import shutil
@@ -163,6 +191,8 @@ class _Sampler(threading.Thread):
 
 
 def run(args) -> int:
+    if getattr(args, "fleet", False):
+        return _run_fleet(args)
     from makisu_tpu.worker import WorkerClient, WorkerServer
 
     concurrency = max(1, args.concurrency)
@@ -408,4 +438,514 @@ def render_report(report: dict) -> str:
             f"{len(traj)} samples")
     lines.append(f"  peak in-flight {report['peak_inflight']}, "
                  f"peak queue depth {report['peak_queue_depth']}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("  fleet:")
+        lines.append(
+            "    distribution " + "  ".join(
+                f"{wid}:{n}" for wid, n in sorted(
+                    fleet["distribution"].items())))
+        lines.append(
+            f"    affinity hit-rate "
+            f"{100.0 * fleet['affinity_hit_rate']:.0f}% "
+            f"(eligible "
+            f"{100.0 * fleet['affinity_hit_rate_eligible']:.0f}%)   "
+            f"verdicts " + " ".join(
+                f"{v}:{n}" for v, n in sorted(
+                    fleet["route_totals"].items())))
+        lines.append(
+            f"    drained {fleet['disruption'].get('drained') or '-'}"
+            f"  killed {fleet['disruption'].get('killed') or '-'}  "
+            f"relocated {fleet['relocated_builds']} "
+            f"(+{fleet['failover_builds']} mid-route failovers)  "
+            f"digests "
+            f"{'identical' if fleet['digest_identity'] else 'DIVERGED'}")
+        lines.append(
+            f"    peer chunks {fleet['peer_chunk_hits']} "
+            f"({fleet['peer_chunk_bytes']} B) served worker-to-worker")
+        lines.append(
+            f"    p99 {fleet['p99_seconds']:.3f}s vs single-worker "
+            f"{fleet['baseline_p99_seconds']:.3f}s "
+            f"(delta {fleet['p99_delta_seconds']:+.3f}s)")
     return "\n".join(lines) + "\n"
+
+
+# -- fleet mode --------------------------------------------------------------
+
+
+def _layer_digests(storage: str, tag: str) -> list[str]:
+    """Layer digests of a built tag, read from the worker's storage —
+    the byte-identity oracle the fleet phases assert against."""
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.storage import ImageStore
+    with ImageStore(storage) as store:
+        manifest = store.manifests.load(ImageName.parse(tag))
+        return [layer.digest.hex() for layer in manifest.layers]
+
+
+def _drive_rounds(socket_path: str, contexts: list[str],
+                  roots: list[str], tenants: list[str],
+                  rounds: int, args, kv_addr: str,
+                  storage_for: "dict | str",
+                  results: list[dict], phase: str,
+                  on_round_end=None) -> None:
+    """K per-context threads × R rounds with a barrier between rounds
+    (so disruption hooks fire at a quiet point, the way a maintenance
+    window would). ``storage_for`` maps worker id -> storage (fleet:
+    the front door rewrites --storage; digests are read back from the
+    serving worker's disk) or is the one storage dir (baseline).
+    Edits land before round 1 only: rounds >= 2 rebuild UNCHANGED
+    content, making cross-worker digest identity assertable."""
+    import threading as threading_mod
+
+    from makisu_tpu.worker import WorkerClient
+
+    n = len(contexts)
+    barrier = threading_mod.Barrier(
+        n, action=(lambda: on_round_end(round_cell[0]))
+        if on_round_end else None)
+    round_cell = [0]
+    results_mu = threading_mod.Lock()
+
+    def drive(j: int) -> None:
+        client = WorkerClient(socket_path)
+        tenant = tenants[j % len(tenants)]
+        for r in range(rounds):
+            if r == 1:
+                _edit_files(contexts[j], args.edit_churn,
+                            f"{phase}-r{r}")
+            tag = f"loadgen/{phase}-ctx{j}:r{r}"
+            argv = ["--log-level", "error",
+                    "build", contexts[j], "-t", tag,
+                    "--hasher", args.hasher, "--root", roots[j],
+                    "--http-cache-addr", kv_addr]
+            if isinstance(storage_for, str):
+                argv += ["--storage", storage_for]
+            t0 = time.monotonic()
+            try:
+                code = client.build(argv, tenant=tenant)
+            except (OSError, RuntimeError,
+                    http.client.HTTPException) as e:
+                # A dropped stream (front-door handler death) raises
+                # IncompleteRead — an HTTPException, not an OSError.
+                # The driver must record the failure and reach the
+                # barrier, not die and stall every sibling on the
+                # barrier timeout.
+                code = -1
+                log.error("fleet loadgen ctx %d round %d failed to "
+                          "submit: %s", j, r, e)
+            elapsed = time.monotonic() - t0
+            terminal = client.last_build or {}
+            worker = str(terminal.get("worker", ""))
+            if isinstance(storage_for, str):
+                storage = storage_for
+            else:
+                storage = storage_for.get(worker, "")
+            digests: list[str] = []
+            if code == 0 and storage:
+                try:
+                    digests = _layer_digests(storage, tag)
+                except (OSError, KeyError) as e:
+                    log.warning("could not read digests for %s: %s",
+                                tag, e)
+            with results_mu:
+                results.append({
+                    "phase": phase,
+                    "context": j,
+                    "round": r,
+                    "tenant": tenant,
+                    "exit_code": code,
+                    "latency_seconds": round(elapsed, 3),
+                    "queue_wait_seconds": round(float(
+                        terminal.get("queue_wait_seconds", 0.0)), 3),
+                    "quota_wait_seconds": round(float(
+                        terminal.get("quota_wait_seconds", 0.0)), 3),
+                    "worker": worker,
+                    "verdict": str(terminal.get("fleet_verdict", "")),
+                    "attempts": int(
+                        terminal.get("fleet_attempts", 1) or 1),
+                    "digests": digests,
+                    "warm": r > 0,
+                })
+            try:
+                barrier.wait(timeout=600)
+            except threading_mod.BrokenBarrierError:
+                return  # a sibling died; don't hang the run
+            if j == 0:
+                round_cell[0] = r + 1
+
+    threads = [threading_mod.Thread(target=drive, args=(j,),
+                                    name=f"fleet-ctx-{j}")
+               for j in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _run_fleet(args) -> int:
+    """Fleet topology: baseline pass, then N workers behind the
+    scheduler with a drain + kill disruption between warm rounds."""
+    from makisu_tpu.fleet import FleetServer, WorkerSpec
+    from makisu_tpu.fleet import peers as fleet_peers
+    from makisu_tpu.fleet.kv import SharedKVServer
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+    from makisu_tpu.worker.client import _UnixHTTPConnection
+
+    n_workers = max(2, args.workers)
+    n_ctx = max(2, args.contexts or n_workers)
+    rounds = max(3, args.rounds or 3)
+    tenants = [t for t in (args.tenants or "").split(",") if t] \
+        or ["default"]
+    work_dir = args.work_dir or tempfile.mkdtemp(
+        prefix="makisu-fleet-loadgen-")
+    os.makedirs(work_dir, exist_ok=True)
+    cleanup_work = not args.work_dir
+
+    servers: dict[str, object] = {}
+    specs: list[WorkerSpec] = []
+    fleet_server = None
+    fleet_kv = None
+    baseline_kv = None
+    baseline_server = None
+    results: list[dict] = []
+    baseline_results: list[dict] = []
+    disruption = {"drained": "", "killed": ""}
+    sampler = None
+    fleet_stats: dict = {}
+    wall = 0.0
+
+    def spawn_worker(wid: str):
+        sock = os.path.join(work_dir, f"{wid}.sock")
+        server = WorkerServer(
+            sock, max_concurrent_builds=args.max_concurrent_builds)
+        server.serve_background()
+        return server, os.path.join(work_dir, f"{wid}-storage")
+
+    def wait_ready(socket_path: str) -> bool:
+        client = WorkerClient(socket_path)
+        deadline = time.monotonic() + args.ready_timeout
+        while not client.ready():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+        return True
+
+    def make_contexts(prefix: str):
+        ctxs, roots = [], []
+        for j in range(n_ctx):
+            ctx = os.path.join(work_dir, f"{prefix}-ctx{j}")
+            _make_template(ctx, j, args.files, args.file_kb)
+            root = os.path.join(work_dir, f"{prefix}-root{j}")
+            os.makedirs(root, exist_ok=True)
+            ctxs.append(ctx)
+            roots.append(root)
+        return ctxs, roots
+
+    try:
+        # ---- single-worker baseline at equal load (fresh contexts,
+        # storage, and KV: nothing here may warm the fleet phase).
+        baseline_kv = SharedKVServer()
+        baseline_addr = baseline_kv.start()
+        baseline_server, baseline_storage = spawn_worker("baseline")
+        if not wait_ready(baseline_server.socket_path):
+            log.error("baseline worker never became ready")
+            return 1
+        base_ctxs, base_roots = make_contexts("base")
+        t0 = time.monotonic()
+        _drive_rounds(baseline_server.socket_path, base_ctxs,
+                      base_roots, tenants, rounds, args,
+                      baseline_addr, baseline_storage,
+                      baseline_results, "baseline")
+        baseline_wall = time.monotonic() - t0
+        baseline_server.shutdown()
+        baseline_server.server_close()
+        baseline_server = None
+        baseline_kv.stop()
+        baseline_kv = None
+
+        # ---- the fleet: N workers + shared KV + front door.
+        fleet_kv = SharedKVServer()
+        kv_addr = fleet_kv.start()
+        for i in range(n_workers):
+            wid = f"w{i}"
+            server, storage = spawn_worker(wid)
+            servers[wid] = server
+            specs.append(WorkerSpec(
+                wid, server.socket_path, storage))
+        for spec in specs:
+            if not wait_ready(spec.socket_path):
+                log.error("fleet worker %s never became ready",
+                          spec.id)
+                return 1
+        fleet_server = FleetServer(
+            os.path.join(work_dir, "fleet.sock"), specs,
+            poll_interval=min(args.poll_interval, 0.5),
+            tenant_quota=args.tenant_quota)
+        fleet_server.serve_background()
+        if not wait_ready(fleet_server.socket_path):
+            log.error("fleet front door never became ready")
+            return 1
+        front = WorkerClient(fleet_server.socket_path)
+        sampler = _Sampler(front, args.poll_interval)
+        sampler.start()
+        ctxs, roots = make_contexts("fleet")
+        storage_for = {spec.id: spec.storage for spec in specs}
+
+        def holder_of(context_index: int) -> str:
+            for row in reversed(results):
+                if row["context"] == context_index \
+                        and row["exit_code"] == 0:
+                    return row["worker"]
+            return ""
+
+        def disrupt(finished_round: int) -> None:
+            """Barrier action between rounds: after the warm round,
+            drain context 0's session holder (its contexts relocate
+            and peer-fetch their chunks from it) and kill a DIFFERENT
+            worker outright (its contexts complete via failover)."""
+            if finished_round != 1:
+                return
+            drained = holder_of(0)
+            if drained:
+                conn = _UnixHTTPConnection(fleet_server.socket_path,
+                                           10.0)
+                try:
+                    conn.request(
+                        "POST", "/drain",
+                        body=json.dumps({"worker": drained}).encode(),
+                        headers={"Content-Type": "application/json"})
+                    conn.getresponse().read()
+                    disruption["drained"] = drained
+                except OSError as e:
+                    log.warning("drain failed: %s", e)
+                finally:
+                    conn.close()
+            victims = [wid for wid in servers
+                       if wid != drained]
+            # Prefer a victim that actually holds contexts, so the
+            # kill forces real failover work — but never kill the
+            # LAST routable worker (a 2-worker fleet drains only;
+            # the kill phase needs >= 3).
+            holders = {holder_of(j) for j in range(n_ctx)}
+            preferred = [w for w in victims if w in holders]
+            victim = (preferred or victims)[0] \
+                if len(victims) >= 2 else ""
+            if victim:
+                server = servers.pop(victim)
+                server.shutdown()
+                server.server_close()
+                try:
+                    os.unlink(server.socket_path)
+                except OSError:
+                    pass
+                disruption["killed"] = victim
+                log.info("fleet loadgen: drained %s, killed %s",
+                         drained or "<none>", victim)
+
+        t0 = time.monotonic()
+        _drive_rounds(fleet_server.socket_path, ctxs, roots, tenants,
+                      rounds, args, kv_addr, storage_for, results,
+                      "fleet", on_round_end=disrupt)
+        wall = time.monotonic() - t0
+        fleet_stats = json.loads(_front_get(
+            fleet_server.socket_path, "/fleet"))
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        if fleet_server is not None:
+            fleet_server.shutdown()
+            fleet_server.server_close()
+        for server in servers.values():
+            server.shutdown()
+            server.server_close()
+        for stoppable in (baseline_server,):
+            if stoppable is not None:
+                stoppable.shutdown()
+                stoppable.server_close()
+        for kv in (fleet_kv, baseline_kv):
+            if kv is not None:
+                kv.stop()
+        fleet_peers.reset()
+        if cleanup_work:
+            shutil.rmtree(work_dir, ignore_errors=True)
+
+    report = _build_fleet_report(args, results, baseline_results,
+                                 disruption, fleet_stats, sampler,
+                                 wall, baseline_wall, tenants,
+                                 n_workers, n_ctx, rounds,
+                                 metrics.global_registry())
+    if args.report:
+        metrics.write_json_atomic(args.report, report)
+        log.info("fleet loadgen report written to %s", args.report)
+    print(render_report(report), end="")
+    # The BASELINE phase's failures gate the exit code too: a broken
+    # baseline corrupts the p99 comparison the fleet section quotes.
+    ok = (report["failures"] == 0 and results
+          and report["fleet"]["baseline"]["failures"] == 0
+          and baseline_results
+          and report["fleet"]["digest_identity"])
+    return 0 if ok else 1
+
+
+def _front_get(socket_path: str, path: str) -> bytes:
+    from makisu_tpu.worker.client import _UnixHTTPConnection
+    conn = _UnixHTTPConnection(socket_path, 10.0)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read()
+    finally:
+        conn.close()
+
+
+def _build_fleet_report(args, results, baseline_results, disruption,
+                        fleet_stats, sampler, wall, baseline_wall,
+                        tenants, n_workers, n_ctx, rounds,
+                        registry) -> dict:
+    ok_rows = [r for r in results if r["exit_code"] == 0]
+    latencies = [r["latency_seconds"] for r in ok_rows]
+    base_ok = [r for r in baseline_results if r["exit_code"] == 0]
+    base_latencies = [r["latency_seconds"] for r in base_ok]
+    # Per-worker build distribution.
+    distribution: dict[str, int] = {}
+    for r in ok_rows:
+        if r["worker"]:
+            distribution[r["worker"]] = \
+                distribution.get(r["worker"], 0) + 1
+    # Affinity hit-rate over post-warmup builds. "Eligible" excludes
+    # builds whose session holder had been drained/killed by the time
+    # they routed (the disruption lands between rounds 1 and 2) —
+    # those CANNOT route affinity, and the metric is "routes to the
+    # session holder when one exists". The excluded ones are counted
+    # separately as relocations.
+    disrupted = {disruption.get("drained", ""),
+                 disruption.get("killed", "")} - {""}
+    warm = [r for r in ok_rows if r["round"] >= 1]
+    prior_holder: dict[tuple, str] = {}
+    for r in sorted(results, key=lambda r: (r["context"], r["round"])):
+        prior_holder[(r["context"], r["round"] + 1)] = r["worker"]
+
+    def relocated(row) -> bool:
+        return (row["round"] >= 2
+                and prior_holder.get((row["context"], row["round"]),
+                                     "") in disrupted)
+
+    eligible = [r for r in warm if not relocated(r)]
+    affinity_all = sum(1 for r in warm if r["verdict"] == "affinity")
+    affinity_eligible = sum(1 for r in eligible
+                            if r["verdict"] == "affinity")
+    relocations = sum(1 for r in warm if relocated(r))
+    # Digest identity: rounds >= 2 rebuild UNCHANGED content, so each
+    # build's digests must equal the same context's round-1 digests —
+    # across relocation, failover, and peer-fetched chunks. A row that
+    # CANNOT be compared (its digests were unreadable, or its context
+    # has no round-1 reference) counts as UNVERIFIED and fails the
+    # gate too: "identical" must never be a vacuous pass.
+    reference: dict[int, list] = {
+        r["context"]: r["digests"] for r in ok_rows
+        if r["round"] == 1 and r["digests"]}
+    comparable = [r for r in ok_rows if r["round"] >= 2]
+    unverified = [
+        {"context": r["context"], "round": r["round"],
+         "worker": r["worker"]}
+        for r in comparable
+        if not r["digests"] or reference.get(r["context"]) is None]
+    mismatches = [
+        {"context": r["context"], "round": r["round"],
+         "worker": r["worker"]}
+        for r in comparable
+        if r["digests"]
+        and reference.get(r["context"]) not in (None, r["digests"])]
+    digest_identity = (bool(comparable) and not mismatches
+                       and not unverified)
+    route_totals = fleet_stats.get("route_totals", {})
+    peer_hits = int(registry.counter_total(
+        "makisu_fleet_peer_chunk_hits_total"))
+    peer_bytes = int(registry.counter_total(
+        "makisu_fleet_peer_chunk_bytes_total"))
+    chunk_serves = int(registry.counter_total(
+        "makisu_fleet_chunk_serves_total", result="hit"))
+    fleet_p99 = metrics.percentile_stats(latencies).get("p99", 0.0)
+    base_p99 = metrics.percentile_stats(base_latencies).get("p99", 0.0)
+    failovers = [r for r in ok_rows if r["verdict"] == "failover"
+                 or r["attempts"] > 1]
+    return {
+        "schema": LOADGEN_SCHEMA,
+        "mode": "fleet",
+        "config": {
+            "workers": n_workers,
+            "contexts": n_ctx,
+            "rounds": rounds,
+            "files": args.files,
+            "file_kb": args.file_kb,
+            "edit_churn": args.edit_churn,
+            "tenants": tenants,
+            "tenant_quota": args.tenant_quota,
+            "hasher": args.hasher,
+            "max_concurrent_builds": args.max_concurrent_builds,
+        },
+        "wall_seconds": round(wall, 3),
+        "builds": len(results),
+        "failures": sum(1 for r in results if r["exit_code"] != 0),
+        "latency_seconds": metrics.percentile_stats(latencies),
+        "queue_wait_seconds": metrics.percentile_stats(
+            [r["queue_wait_seconds"] for r in ok_rows]),
+        "exec_seconds": metrics.percentile_stats(
+            [max(r["latency_seconds"] - r["queue_wait_seconds"]
+                 - r["quota_wait_seconds"], 0.0) for r in ok_rows]),
+        "cold_latency_seconds": metrics.percentile_stats(
+            [r["latency_seconds"] for r in ok_rows
+             if not r["warm"]]),
+        "warm_latency_seconds": metrics.percentile_stats(
+            [r["latency_seconds"] for r in ok_rows if r["warm"]]),
+        "tenant_latency_seconds": {
+            tenant: metrics.percentile_stats(
+                [r["latency_seconds"] for r in ok_rows
+                 if r["tenant"] == tenant])
+            for tenant in tenants},
+        "hash_batch_occupancy": None,
+        "queue_wait_share": 0.0,
+        "tenant_fairness_p99_ratio": 1.0,
+        "throughput_builds_per_s": round(len(results) / wall, 3)
+        if wall else 0.0,
+        "peak_inflight": sampler.peak_inflight if sampler else 0,
+        "peak_queue_depth": sampler.peak_queue_depth if sampler else 0,
+        "saw_running_build": bool(sampler
+                                  and sampler.saw_running_build),
+        "cache_trajectory": sampler.samples if sampler else [],
+        "fleet": {
+            "distribution": dict(sorted(distribution.items())),
+            "affinity_hit_rate": round(
+                affinity_all / len(warm), 4) if warm else 0.0,
+            "affinity_hit_rate_eligible": round(
+                affinity_eligible / len(eligible), 4)
+            if eligible else 0.0,
+            "route_totals": route_totals,
+            "quota_denied": int(route_totals.get("quota_denied", 0)),
+            "disruption": dict(disruption),
+            "relocated_builds": relocations,
+            "failover_builds": len(failovers),
+            "digest_identity": digest_identity,
+            "digest_mismatches": mismatches,
+            "digest_unverified": unverified,
+            "peer_chunk_hits": peer_hits,
+            "peer_chunk_bytes": peer_bytes,
+            "peer_chunk_serves": chunk_serves,
+            "baseline": {
+                "wall_seconds": round(baseline_wall, 3),
+                "builds": len(baseline_results),
+                "failures": sum(1 for r in baseline_results
+                                if r["exit_code"] != 0),
+                "latency_seconds": metrics.percentile_stats(
+                    base_latencies),
+            },
+            "p99_seconds": fleet_p99,
+            "baseline_p99_seconds": base_p99,
+            "p99_delta_seconds": round(fleet_p99 - base_p99, 3),
+            "p99_ratio": round(fleet_p99 / base_p99, 3)
+            if base_p99 else 0.0,
+            "workers": fleet_stats.get("workers", []),
+        },
+        "results": results,
+        "baseline_results": baseline_results,
+    }

@@ -28,16 +28,49 @@ def _trunc(text: str, width: int) -> str:
     return text if len(text) <= width else text[:width - 1] + "…"
 
 
+def _fleet_lines(fleet: dict) -> list[str]:
+    """The fleet section: one row per worker (state, load, resident
+    sessions, routing share) plus the scheduler's verdict tallies."""
+    lines = [
+        "",
+        f"fleet — {len(fleet.get('workers', []))} workers   "
+        f"front-door queued {fleet.get('frontdoor_waiting', 0)}   "
+        f"tenant quota "
+        f"{fleet.get('tenant_quota', 0) or 'off'}   "
+        f"peer map v{fleet.get('peer_map_version', 0)}",
+        f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} {'QUEUE':>6s} "
+        f"{'SESS':>5s} {'ROUTED':>7s}  SOCKET",
+    ]
+    for w in fleet.get("workers", []):
+        lines.append(
+            f"{_trunc(w.get('id', '?'), 8):<8s} "
+            f"{w.get('state', '?'):<9s} "
+            f"{w.get('active_builds', 0):>6d} "
+            f"{w.get('queue_depth', 0):>6d} "
+            f"{len(w.get('sessions', [])):>5d} "
+            f"{w.get('routed_total', 0):>7d}  "
+            f"{_trunc(w.get('socket', ''), 36)}")
+    totals = fleet.get("route_totals", {})
+    if totals:
+        lines.append("routing: " + "  ".join(
+            f"{verdict} {n}" for verdict, n in sorted(totals.items())))
+    return lines
+
+
 def render_top(health: dict, builds: dict, socket_path: str) -> str:
     """One frame. Pure function of the two payloads, so tests (and
-    any other consumer) can render canned snapshots."""
+    any other consumer) can render canned snapshots. A fleet front
+    door's payload (it carries a ``fleet`` section) gets the
+    per-worker table appended and a WORKER column on build rows."""
     from makisu_tpu.utils.traceexport import fmt_bytes
     queue = health.get("queue", {})
     wait = queue.get("wait_seconds", {})
     latency = queue.get("latency_seconds", {})
     cap = queue.get("max_concurrent_builds", 0)
+    fleet = health.get("fleet")
+    title = "fleet" if fleet else "top"
     lines = [
-        f"makisu-tpu top — {socket_path}   "
+        f"makisu-tpu {title} — {socket_path}   "
         f"uptime {_fmt_age(health.get('uptime_seconds', 0.0))}   "
         f"active {health.get('active_builds', 0)}   "
         f"queued {builds.get('queue_depth', 0)}"
@@ -54,7 +87,8 @@ def render_top(health: dict, builds: dict, socket_path: str) -> str:
         f"{health.get('last_progress_seconds', 0.0):.1f}s ago",
         "",
         f"{'ID':>4s} {'TENANT':<12s} {'STATE':<8s} {'PHASE':<6s} "
-        f"{'QWAIT':>7s} {'AGE':>7s} {'PROG':>6s} {'CACHE':>6s}  TAG",
+        f"{'QWAIT':>7s} {'AGE':>7s} {'PROG':>6s} {'CACHE':>6s}  "
+        + (f"{'WORKER':<7s} " if fleet else "") + "TAG",
     ]
     rows = list(builds.get("inflight", []))
     for b in rows:
@@ -71,9 +105,13 @@ def render_top(health: dict, builds: dict, socket_path: str) -> str:
             f"{_fmt_age(b.get('age_seconds', 0.0)):>7s} "
             f"{_fmt_age(b.get('progress_age_seconds', 0.0)):>6s} "
             f"{cache_part:>6s}  "
-            f"{_trunc(b.get('tag') or b.get('command', ''), 28)}")
+            + (f"{_trunc(b.get('worker') or '-', 7):<7s} "
+               if fleet else "")
+            + f"{_trunc(b.get('tag') or b.get('command', ''), 28)}")
     if not rows:
         lines.append("  (no builds in flight)")
+    if fleet:
+        lines.extend(_fleet_lines(fleet))
     recent = list(builds.get("recent", []))[:8]
     if recent:
         lines.append("")

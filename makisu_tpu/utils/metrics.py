@@ -60,6 +60,27 @@ DEVICE_COMPILE_SECONDS = "makisu_device_compile_seconds"
 DEVICE_H2D_BYTES = "makisu_device_h2d_bytes_total"
 DEVICE_PADDING_WASTE = "makisu_device_padding_waste_bytes_total"
 
+# Fleet telemetry (makisu_tpu/fleet/): one name set shared by the
+# scheduler, the peer-exchange module, the worker's /chunks endpoint,
+# loadgen's fleet report, and the docs' metric table. Routing verdicts
+# label makisu_fleet_route_total (affinity|spillover|failover|
+# quota_denied); the peer counters count CHUNKS served worker-to-worker
+# before any registry round trip.
+FLEET_ROUTE_TOTAL = "makisu_fleet_route_total"
+FLEET_WORKERS = "makisu_fleet_workers"
+FLEET_FRONTDOOR_QUEUE = "makisu_fleet_frontdoor_queue_depth"
+FLEET_INFLIGHT_BUILDS = "makisu_fleet_inflight_builds"
+FLEET_TENANT_INFLIGHT = "makisu_fleet_tenant_inflight"
+FLEET_QUOTA_WAIT = "makisu_fleet_quota_wait_seconds"
+FLEET_RETRIES = "makisu_fleet_build_retries_total"
+FLEET_BUILD_LATENCY = "makisu_fleet_build_latency_seconds"
+FLEET_PEER_CHUNK_HITS = "makisu_fleet_peer_chunk_hits_total"
+FLEET_PEER_CHUNK_MISSES = "makisu_fleet_peer_chunk_misses_total"
+FLEET_PEER_CHUNK_BYTES = "makisu_fleet_peer_chunk_bytes_total"
+FLEET_PEER_MAP_VERSION = "makisu_fleet_peer_map_version"
+FLEET_CHUNK_SERVES = "makisu_fleet_chunk_serves_total"
+FLEET_CHUNK_SERVE_BYTES = "makisu_fleet_chunk_serve_bytes_total"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.

@@ -11,21 +11,74 @@ import http.client
 import json
 import os
 import socket
+import time
 
 from makisu_tpu.utils import fileio
 from makisu_tpu.utils import logging as log
 
+# Transient transport failures a control-plane GET may retry: the
+# socket vanished/refused (worker restarting), the connection died
+# mid-exchange, or the worker sat past the timeout. Deliberately NOT
+# retried: HTTP-level errors (the worker answered; retrying won't
+# change its mind) and anything on POST /build (failover across
+# workers is the scheduler's job, not the client's).
+_TRANSIENT_ERRORS = (ConnectionError, FileNotFoundError, socket.timeout,
+                     http.client.RemoteDisconnected,
+                     http.client.NotConnected)
+
 
 class _UnixHTTPConnection(http.client.HTTPConnection):
-    def __init__(self, path: str, timeout: float) -> None:
+    """HTTP over a unix socket with a SEPARATE connect timeout: an
+    unreachable worker (dead socket, full backlog) must fail the
+    caller in ``connect_timeout`` seconds, while reads keep the long
+    ``timeout`` a multi-minute build stream legitimately needs. The
+    fleet scheduler's failover path depends on the former being
+    prompt."""
+
+    def __init__(self, path: str, timeout: float,
+                 connect_timeout: float | None = None) -> None:
         super().__init__("localhost", timeout=timeout)
         self._path = path
+        self._connect_timeout = (connect_timeout
+                                 if connect_timeout is not None
+                                 else timeout)
 
     def connect(self) -> None:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self.timeout)
+        sock.settimeout(self._connect_timeout)
         sock.connect(self._path)
+        sock.settimeout(self.timeout)
         self.sock = sock
+
+
+def iter_stream_lines(resp, chunk_size: int = 4096):
+    """Complete NDJSON lines (bytes, blank lines skipped) from a
+    streamed HTTP response — the ONE framing loop shared by
+    ``WorkerClient.build`` and the fleet forwarder, so the /build wire
+    format has a single parser. Stops at EOF; a truncated trailing
+    fragment (no newline) is dropped — exactly the mid-stream-death
+    signal both consumers read as "no terminal frame arrived"."""
+    buf = b""
+    while True:
+        chunk = resp.read(chunk_size)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                yield line
+
+
+def terminal_exit_code(payload: dict) -> int:
+    """Exit code from a /build terminal frame: ``exit_code`` (data)
+    first, the stringly legacy ``build_code`` second."""
+    code = payload.get("exit_code")
+    try:
+        return int(payload["build_code"]) if code is None \
+            else int(code)
+    except (KeyError, TypeError, ValueError):
+        return 1
 
 
 class PercentileStats(dict):
@@ -199,11 +252,25 @@ class WorkerClient:
     def __init__(self, socket_path: str,
                  local_shared_path: str = "",
                  worker_shared_path: str = "",
-                 timeout: float = 3600.0) -> None:
+                 timeout: float = 3600.0,
+                 connect_timeout: float = 5.0,
+                 control_timeout: float = 15.0,
+                 retries: int = 2) -> None:
         self.socket_path = socket_path
         self.local_shared_path = local_shared_path
         self.worker_shared_path = worker_shared_path
+        # `timeout` is the read timeout for the /build stream (a slow
+        # build's frames may be minutes apart); control-plane GETs
+        # (/healthz, /builds, /metrics, ...) use the much shorter
+        # `control_timeout` — a dashboard poll or a scheduler health
+        # probe hanging for an hour against a wedged worker is exactly
+        # the failure mode the fleet needs surfaced promptly.
         self.timeout = timeout
+        self.connect_timeout = connect_timeout
+        self.control_timeout = control_timeout
+        # Bounded retry budget for transient socket errors on
+        # idempotent control-plane requests (0 disables).
+        self.retries = max(int(retries), 0)
         # Terminal payload of the last build() call: exit_code and
         # elapsed_seconds as data, no log-text parsing needed.
         self.last_build: dict = {}
@@ -212,28 +279,51 @@ class WorkerClient:
         self.last_events: list[dict] = []
 
     def _request(self, method: str, path: str, body: bytes | None = None,
-                 tenant: str = ""):
-        conn = _UnixHTTPConnection(self.socket_path, self.timeout)
-        headers = {"Content-Type": "application/json"} if body else {}
+                 tenant: str = "", headers: dict | None = None,
+                 timeout: float | None = None, retry: bool = False):
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
         if tenant:
-            headers["X-Makisu-Tenant"] = tenant
-        conn.request(method, path, body=body, headers=headers)
-        return conn, conn.getresponse()
+            hdrs["X-Makisu-Tenant"] = tenant
+        attempts = 1 + (self.retries if retry else 0)
+        for attempt in range(attempts):
+            conn = _UnixHTTPConnection(
+                self.socket_path,
+                self.timeout if timeout is None else timeout,
+                connect_timeout=self.connect_timeout)
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                return conn, conn.getresponse()
+            except _TRANSIENT_ERRORS:
+                conn.close()
+                if attempt + 1 >= attempts:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
+
+    def _control(self, path: str):
+        """Idempotent control-plane GET: short timeout, bounded
+        retry on transient socket errors."""
+        return self._request("GET", path,
+                             timeout=self.control_timeout, retry=True)
 
     def ready(self) -> bool:
         try:
-            conn, resp = self._request("GET", "/ready")
+            # No retry: ready() is the poll primitive — each call must
+            # answer promptly so spin-wait loops keep their cadence.
+            conn, resp = self._request("GET", "/ready",
+                                       timeout=self.control_timeout)
             try:
                 resp.read()
                 return resp.status == 200
             finally:
                 conn.close()
-        except OSError:
+        except (OSError, http.client.HTTPException):
             return False
 
     def metrics(self) -> str:
         """The worker's Prometheus text exposition (``GET /metrics``)."""
-        conn, resp = self._request("GET", "/metrics")
+        conn, resp = self._control("/metrics")
         try:
             if resp.status != 200:
                 raise RuntimeError(
@@ -267,7 +357,7 @@ class WorkerClient:
         """The worker's ``GET /healthz`` payload: uptime, build
         outcome counts, and the admission queue's depth/latency
         digests — typed via :class:`WorkerHealth` (still a dict)."""
-        conn, resp = self._request("GET", "/healthz")
+        conn, resp = self._control("/healthz")
         try:
             if resp.status != 200:
                 raise RuntimeError(
@@ -280,7 +370,7 @@ class WorkerClient:
         """The worker's ``GET /sessions`` payload: per-context
         resident build sessions (builds served, hits, resident bytes,
         dirty-tracker mode) plus invalidation tallies."""
-        conn, resp = self._request("GET", "/sessions")
+        conn, resp = self._control("/sessions")
         try:
             if resp.status != 200:
                 raise RuntimeError(
@@ -309,7 +399,7 @@ class WorkerClient:
         """The worker's ``GET /builds`` payload: in-flight + recently
         finished builds (tenant, phase, queue wait, progress age,
         cache economics) plus queue depth/cap."""
-        conn, resp = self._request("GET", "/builds")
+        conn, resp = self._control("/builds")
         try:
             if resp.status != 200:
                 raise RuntimeError(
@@ -347,32 +437,23 @@ class WorkerClient:
             if resp.status != 200:
                 raise RuntimeError(
                     f"worker /build returned {resp.status}")
-            buf = b""
-            while True:
-                chunk = resp.read(4096)
-                if not chunk:
-                    break
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    try:
-                        payload = json.loads(line)
-                    except ValueError:
-                        log.info(line.decode(errors="replace"))
-                        continue
-                    if "build_code" in payload:
-                        build_code = int(payload["build_code"])
-                        self.last_build = payload
-                    elif "event" in payload:
-                        self.last_events.append(payload["event"])
-                        if on_event is not None:
-                            on_event(payload["event"])
-                    else:
-                        if on_line is not None:
-                            on_line(payload)
-                        log.info("[worker] %s", payload.get("msg", line))
+            for line in iter_stream_lines(resp):
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    log.info(line.decode(errors="replace"))
+                    continue
+                if "build_code" in payload:
+                    build_code = terminal_exit_code(payload)
+                    self.last_build = payload
+                elif "event" in payload:
+                    self.last_events.append(payload["event"])
+                    if on_event is not None:
+                        on_event(payload["event"])
+                else:
+                    if on_line is not None:
+                        on_line(payload)
+                    log.info("[worker] %s", payload.get("msg", line))
         finally:
             conn.close()
         return build_code

@@ -131,6 +131,16 @@ class _AdmissionQueue:
         with self._mu:
             return len(self._waiters)
 
+    def would_block(self) -> bool:
+        """Whether an acquire right now would wait (the fleet front
+        door's no-wait admission probe — advisory: the answer can go
+        stale by the time the build actually acquires, in which case
+        it simply queues like any other arrival)."""
+        if self.limit <= 0:
+            return False
+        with self._mu:
+            return self._running >= self.limit or bool(self._waiters)
+
 
 class _BuildRecord:
     """One build's row in ``GET /builds``: identity, queue state, and
@@ -281,12 +291,26 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/sessions":
             # Resident build sessions: per-context warm state (builds
             # served, hits, resident bytes, dirty-tracker mode) plus
-            # the manager's invalidation tallies.
-            from makisu_tpu.worker import session as session_mod
+            # the manager's invalidation tallies. THIS server's manager
+            # — the fleet scheduler polls it as the affinity signal, so
+            # it must describe this worker's residency, not (in an
+            # in-process fleet) a sibling's.
             self._respond(
                 200,
-                json.dumps(session_mod.manager().stats()).encode(),
+                json.dumps(self.server.session_mgr.stats()).encode(),
                 content_type="application/json")
+        elif self.path.startswith("/chunks/"):
+            # Peer chunk exchange, serving side: read-only chunk bytes
+            # out of the local chunk CAS(es). Strictly local — a miss
+            # is a prompt 404, never a proxied fetch (see
+            # cache/chunks.py open_served_chunk).
+            self._serve_chunk(self.path[len("/chunks/"):])
+        elif self.path == "/peers":
+            from makisu_tpu.fleet import peers as fleet_peers
+            self._respond(200, json.dumps({
+                "version": fleet_peers.map_version(),
+                "peers": list(fleet_peers.peers()),
+            }).encode(), content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -296,13 +320,63 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._respond(404, b"not found")
 
+    def _serve_chunk(self, name: str) -> None:
+        """``GET /chunks/<fingerprint>``: stream one chunk's bytes.
+        The name is validated as a full lowercase-hex sha256 BEFORE it
+        touches any path machinery — this endpoint fronts a CAS whose
+        keys become file paths."""
+        from makisu_tpu.cache import chunks as chunks_mod
+        from makisu_tpu.utils import metrics
+        if len(name) != 64 or any(c not in "0123456789abcdef"
+                                  for c in name):
+            self._respond(400, b"bad chunk fingerprint")
+            return
+        fh = chunks_mod.open_served_chunk(
+            name, roots=self.server.served_chunk_roots())
+        if fh is None:
+            metrics.global_registry().counter_add(
+                metrics.FLEET_CHUNK_SERVES, result="miss")
+            self._respond(404, b"chunk not held here")
+            return
+        try:
+            with fh:
+                data = fh.read()
+            metrics.global_registry().counter_add(
+                metrics.FLEET_CHUNK_SERVES, result="hit")
+            metrics.global_registry().counter_add(
+                metrics.FLEET_CHUNK_SERVE_BYTES, len(data))
+            self._respond(200, data,
+                          content_type="application/octet-stream")
+        except OSError:
+            # Evicted between open and read: a miss, not an error.
+            self._respond(404, b"chunk not held here")
+
     def do_POST(self) -> None:
+        if self.path == "/peers":
+            # The fleet scheduler publishes the peer map here; builds
+            # on this worker consult those sockets for missing chunks
+            # before paying the registry (cache/chunks.py).
+            from makisu_tpu.fleet import peers as fleet_peers
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length)) or {}
+                peer_list = list(body.get("peers") or [])
+                version = body.get("version")
+                version = int(version) if version is not None else None
+            except (ValueError, TypeError, AttributeError):
+                self._respond(400, b"bad peers json")
+                return
+            applied = fleet_peers.set_peers(peer_list, version)
+            self._respond(200, json.dumps(
+                {"applied": applied,
+                 "version": fleet_peers.map_version()}).encode(),
+                content_type="application/json")
+            return
         if self.path == "/sessions/invalidate":
             # Explicit session invalidation: body ``{"context": PATH}``
             # drops that context's session, ``{}`` (or no body) drops
             # every idle session. Busy sessions survive (their build
             # owns them); the response reports the dropped count.
-            from makisu_tpu.worker import session as session_mod
             length = int(self.headers.get("Content-Length", "0"))
             context = ""
             if length:
@@ -312,7 +386,7 @@ class _Handler(BaseHTTPRequestHandler):
                 except (ValueError, AttributeError):
                     self._respond(400, b"bad json body")
                     return
-            dropped = session_mod.manager().invalidate(context)
+            dropped = self.server.session_mgr.invalidate(context)
             self._respond(200, json.dumps(
                 {"invalidated": dropped}).encode(),
                 content_type="application/json")
@@ -340,6 +414,22 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(argv, list) or not all(
                 isinstance(a, str) for a in argv):
             self._respond(400, b"bad argv json")
+            return
+        # Cooperative admission refusal: a fleet scheduler with other
+        # candidate workers sends X-Makisu-No-Wait so a saturated
+        # worker answers 503 NOW instead of silently queuing the build
+        # behind its cap — the scheduler then fails over to the
+        # next-best worker. Advisory (the real acquire happens in
+        # run_build): a lost race just queues, exactly as if the
+        # header had not been sent.
+        if (self.headers.get("X-Makisu-No-Wait")
+                and self.server._admission.would_block()):
+            self._respond(503, json.dumps({
+                "error": "admission_refused",
+                "queue_depth": self.server._admission.depth(),
+                "max_concurrent_builds":
+                    self.server.max_concurrent_builds,
+            }).encode(), content_type="application/json")
             return
         self.send_response(200)
         self.send_header("Transfer-Encoding", "chunked")
@@ -418,6 +508,11 @@ def _effective_flags(argv: list[str]) -> dict:
     return out
 
 
+def _peer_map_version() -> int:
+    from makisu_tpu.fleet import peers as fleet_peers
+    return fleet_peers.map_version()
+
+
 def _warm_probe_wanted() -> bool:
     """Whether worker startup should begin JAX backend init eagerly.
     Explicit MAKISU_TPU_WORKER_WARM_PROBE=1/0 wins; otherwise probe
@@ -446,6 +541,12 @@ def _warm_probe_wanted() -> bool:
              "set MAKISU_TPU_WORKER_WARM_PROBE=1 if this host has an "
              "accelerator via default discovery")
     return False
+
+
+# Shared-path serialization across every WorkerServer in the process
+# (see WorkerServer.__init__).
+_PATH_LOCKS: dict[str, threading.Lock] = {}
+_PATH_LOCKS_MU = threading.Lock()
 
 
 class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
@@ -512,10 +613,25 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         if _warm_probe_wanted():
             from makisu_tpu.ops import backend as _backend
             _backend.warm_probe(source="worker")
+        # Resident build sessions: each server owns ITS OWN manager
+        # (bound per build via the session contextvar) so multiple
+        # in-process workers — the fleet loadgen topology — model real
+        # machines: a session minted on this worker is warm HERE and
+        # nowhere else, and /sessions is a truthful affinity signal.
+        from makisu_tpu.worker import session as session_mod
+        self.session_mgr = session_mod.SessionManager()
+        # Chunk CAS roots THIS server's builds have used: the /chunks
+        # peer endpoint serves only these (the process-wide registry
+        # would also hold in-process siblings' stores, and serving a
+        # sibling's bytes would fake the cross-host exchange).
+        self._served_chunk_roots: set[str] = set()
         # Builds sharing a --root or --storage directory would race on
-        # the filesystem; those (and only those) serialize.
-        self._path_locks: dict[str, threading.Lock] = {}
-        self._path_locks_mu = threading.Lock()
+        # the filesystem; those (and only those) serialize. The lock
+        # table is PROCESS-wide (module global), not per server: two
+        # in-process workers pointed at one storage dir race exactly
+        # like two handler threads of one worker do.
+        self._path_locks = _PATH_LOCKS
+        self._path_locks_mu = _PATH_LOCKS_MU
         # Failure forensics: a process-level flight recorder sees every
         # build's events (global sink — per-build recorders inside each
         # cli.main still keep isolated rings), the resource sampler
@@ -547,6 +663,31 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     def get_request(self):
         request, _ = super().get_request()
         return request, ("worker", 0)
+
+    def handle_error(self, request, client_address) -> None:
+        # A poller (fleet scheduler, top, loadgen sampler) dropping its
+        # keep-alive connection mid-idle is normal churn, not an error
+        # worth a traceback on the worker's stderr.
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
+    def add_served_chunk_root(self, storage_dir: str) -> None:
+        """Mark a storage's chunk CAS as servable by THIS worker's
+        ``/chunks`` endpoint (run_build records every build's storage;
+        embedders/tests may add roots directly — pass the chunk CAS
+        dir itself or the storage dir containing ``chunks/``)."""
+        root = os.path.realpath(storage_dir)
+        chunk_root = os.path.realpath(os.path.join(storage_dir,
+                                                   "chunks"))
+        with self._builds_mu:
+            self._served_chunk_roots.update((root, chunk_root))
+
+    def served_chunk_roots(self) -> set[str]:
+        with self._builds_mu:
+            return set(self._served_chunk_roots)
 
     def register_build(self, argv: list[str],
                        tenant: str = "") -> _BuildRecord:
@@ -638,11 +779,23 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         record.start_running(queue_wait)
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
-        level = _effective_flags(argv)["log_level"]
+        flags = _effective_flags(argv)
+        level = flags["log_level"]
+        if flags["storage"]:
+            # This build's chunk CAS becomes servable to fleet peers.
+            self.add_served_chunk_root(flags["storage"])
         token = log.set_build_sink(sink, level.replace("warn", "warning"))
         events_token = events.add_sink(event_sink)
         record_token = events.add_sink(record.note_event)
         mode_token = cli.invocation_mode.set("worker")
+        # This build's resident-session state lives in THIS server's
+        # manager, and its peer chunk fetches must skip this server's
+        # own socket — both context-scoped, so the threads the build
+        # spawns inherit them.
+        from makisu_tpu.fleet import peers as fleet_peers
+        from makisu_tpu.worker import session as session_mod
+        session_token = session_mod.bind_manager(self.session_mgr)
+        peers_token = fleet_peers.bind_self_socket(self.socket_path)
         # Count the build started BEFORE acquiring shared-path locks:
         # a build wedged waiting on another build's --root/--storage
         # must show as active in /healthz — that is the situation the
@@ -691,6 +844,8 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 lock.release()
             self._admission.release()
             self._retire_build(record, code)
+            fleet_peers.reset_self_socket(peers_token)
+            session_mod.reset_manager(session_token)
             cli.invocation_mode.reset(mode_token)
             events.reset_sink(record_token)
             events.reset_sink(events_token)
@@ -773,9 +928,9 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # against the budget, hit/invalidations tallies — the warm-path
         # state a fleet scheduler routes toward (cache affinity) and an
         # operator watches for memory pressure. The per-session rows
-        # stay on GET /sessions; /healthz carries the digest.
-        from makisu_tpu.worker import session as session_mod
-        session_stats = session_mod.manager().stats()
+        # stay on GET /sessions; /healthz carries the digest — THIS
+        # server's own manager, like /sessions.
+        session_stats = self.session_mgr.stats()
         sessions = {k: session_stats[k] for k in
                     ("count", "resident_bytes", "hits",
                      "invalidations", "max_sessions",
@@ -802,6 +957,11 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 "makisu_transfer_inflight_bytes")),
             "transfer_queue_depth": int(g.gauge_value(
                 "makisu_transfer_queue_depth")),
+            # The peer map version this process holds: a worker that
+            # restarted between two scheduler polls (never observed
+            # dead) answers 0 here, telling the scheduler its map was
+            # lost and must be republished.
+            "peer_map_version": _peer_map_version(),
         }
 
     def server_close(self) -> None:

@@ -771,6 +771,27 @@ class SessionManager:
 
 _manager = SessionManager()
 
+# Context-bound manager override: a WorkerServer binds ITS OWN
+# SessionManager around every build it runs, so multiple in-process
+# workers (the fleet loadgen topology, and any test standing up a
+# 3-worker fleet in one interpreter) model real machines — each
+# worker's resident sessions, /sessions rows, and affinity signal are
+# its own, exactly as they would be across separate hosts. Standalone
+# CLI builds and --watch keep the process-global manager.
+_bound_manager: "contextvars.ContextVar[SessionManager | None]" = \
+    contextvars.ContextVar("makisu_session_manager", default=None)
+
+
+def bind_manager(mgr: SessionManager):
+    """Bind ``mgr`` as the current context's session manager (threads
+    the build spawns inherit it via ``contextvars.copy_context``).
+    Returns a reset token."""
+    return _bound_manager.set(mgr)
+
+
+def reset_manager(token) -> None:
+    _bound_manager.reset(token)
+
 
 def manager() -> SessionManager:
-    return _manager
+    return _bound_manager.get() or _manager

@@ -1,0 +1,537 @@
+"""Fleet front door: session-affinity routing, tenant quotas at the
+front door, mid-build failover with digest identity, and peer chunk
+exchange ahead of the registry."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from makisu_tpu.fleet import FleetServer, WorkerSpec
+from makisu_tpu.fleet import peers as fleet_peers
+from makisu_tpu.fleet.kv import SharedKVServer
+from makisu_tpu.fleet.scheduler import FleetScheduler, build_identity
+from makisu_tpu.fleet.server import rewrite_storage
+from makisu_tpu.utils import metrics
+from makisu_tpu.worker import WorkerClient, WorkerServer
+from makisu_tpu.worker.client import _UnixHTTPConnection
+
+
+@pytest.fixture(autouse=True)
+def _clean_peer_map():
+    fleet_peers.reset()
+    yield
+    fleet_peers.reset()
+
+
+def _make_ctx(tmp_path, name="ctx", files=4):
+    ctx = tmp_path / name
+    (ctx / "src").mkdir(parents=True)
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY src/ /src/\n")
+    for i in range(files):
+        (ctx / "src" / f"m{i}.py").write_text(
+            f"# {name} {i}\n" + "x=1\n" * 120)
+    (tmp_path / "root").mkdir(exist_ok=True)
+    return ctx
+
+
+def _build_argv(tmp_path, ctx, kv_addr="", extra=()):
+    argv = ["--log-level", "error", "build", str(ctx),
+            "-t", f"fleet/{ctx.name}:1", "--hasher", "tpu",
+            "--root", str(tmp_path / "root")]
+    if kv_addr:
+        argv += ["--http-cache-addr", kv_addr]
+    return argv + list(extra)
+
+
+class _Fleet:
+    """N in-process workers (each with its own storage) behind a
+    FleetServer, plus a shared KV."""
+
+    def __init__(self, tmp_path, n=2, tenant_quota=0,
+                 poll_interval=0.2):
+        self.kv = SharedKVServer()
+        self.kv_addr = self.kv.start()
+        self.workers = {}
+        specs = []
+        for i in range(n):
+            wid = f"w{i}"
+            server = WorkerServer(str(tmp_path / f"{wid}.sock"))
+            server.serve_background()
+            self.workers[wid] = server
+            specs.append(WorkerSpec(
+                wid, server.socket_path,
+                str(tmp_path / f"{wid}-storage")))
+        self.specs = {s.id: s for s in specs}
+        self.server = FleetServer(str(tmp_path / "fleet.sock"), specs,
+                                  poll_interval=poll_interval,
+                                  tenant_quota=tenant_quota)
+        self.server.serve_background()
+        self.client = WorkerClient(self.server.socket_path)
+        deadline = time.monotonic() + 30
+        while not self.client.ready():
+            assert time.monotonic() < deadline, "fleet never ready"
+            time.sleep(0.05)
+
+    def drain(self, worker_id, undrain=False):
+        conn = _UnixHTTPConnection(self.server.socket_path, 10.0)
+        try:
+            conn.request("POST", "/drain", body=json.dumps(
+                {"worker": worker_id, "undrain": undrain}).encode())
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        for server in self.workers.values():
+            server.shutdown()
+            server.server_close()
+        self.kv.stop()
+
+
+@pytest.fixture
+def fleet2(tmp_path):
+    fleet = _Fleet(tmp_path, n=2)
+    yield fleet
+    fleet.close()
+
+
+def _digests(storage, tag):
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.storage import ImageStore
+    with ImageStore(storage) as store:
+        manifest = store.manifests.load(ImageName.parse(tag))
+        return [layer.digest.hex() for layer in manifest.layers]
+
+
+# -- units ------------------------------------------------------------------
+
+
+def test_rewrite_storage_forms():
+    assert rewrite_storage(["build", "c", "--storage", "/a"], "/b") \
+        == ["build", "c", "--storage", "/b"]
+    assert rewrite_storage(["build", "c", "--storage=/a"], "/b") \
+        == ["build", "c", "--storage=/b"]
+    assert rewrite_storage(["build", "c"], "/b") \
+        == ["build", "c", "--storage", "/b"]
+
+
+def test_build_identity_resolves_context(tmp_path):
+    ctx = tmp_path / "ident-ctx"
+    ctx.mkdir()
+    key, command = build_identity(
+        ["--log-level", "error", "build", str(ctx), "-t", "a/b:1"])
+    assert command == "build"
+    assert key == os.path.realpath(str(ctx))
+    key, command = build_identity(["pull", "busybox"])
+    assert command == "pull" and key == ""
+
+
+def test_client_unreachable_worker_fails_promptly(tmp_path):
+    """The satellite contract: an unreachable worker must fail the
+    caller promptly (bounded retries), not hang it."""
+    client = WorkerClient(str(tmp_path / "nope.sock"),
+                          connect_timeout=0.5, retries=2)
+    t0 = time.monotonic()
+    assert client.ready() is False
+    with pytest.raises(OSError):
+        client.healthz()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_consistent_hash_placement_is_stable():
+    specs = [WorkerSpec(f"w{i}", f"/tmp/w{i}.sock") for i in range(3)]
+    sched = FleetScheduler(specs)
+    for state in sched.workers.values():
+        state.alive = True
+    first = {}
+    for key in ("ctx-a", "ctx-b", "ctx-c", "ctx-d"):
+        worker, verdict, _ = sched.route(key)
+        first[key] = worker.spec.id
+        assert verdict == "spillover"
+    # Same keys re-route to the same owners (now via the sticky memo /
+    # affinity path).
+    for key, wid in first.items():
+        worker, verdict, _ = sched.route(key)
+        assert worker.spec.id == wid
+        assert verdict == "affinity"
+
+
+def test_scheduler_quota_blocks_and_records():
+    specs = [WorkerSpec("w0", "/tmp/w0.sock")]
+    sched = FleetScheduler(specs, tenant_quota=1)
+    sched.workers["w0"].alive = True
+    assert sched.admit("team-a") < 0.05  # unblocked: immediate
+    waited = []
+
+    def second():
+        waited.append(sched.admit("team-a"))
+
+    t = threading.Thread(target=second)
+    t.start()
+    deadline = time.monotonic() + 5
+    while sched.frontdoor_waiting() < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    # The wait was recorded as a quota_denied decision.
+    totals = sched.stats()["route_totals"]
+    assert totals.get("quota_denied", 0) >= 1
+    sched.release("team-a")
+    t.join(timeout=5)
+    assert waited and waited[0] > 0
+    sched.release("team-a")
+    assert sched.frontdoor_waiting() == 0
+    # Other tenants are unaffected by team-a's quota.
+    assert sched.admit("team-b") < 0.05
+    sched.release("team-b")
+
+
+def test_quota_admission_is_fifo():
+    """Front-door quota slots transfer to the OLDEST waiter — a
+    steady arrival stream must not barge past blocked builds (the
+    same fairness contract as the worker's admission queue)."""
+    sched = FleetScheduler([WorkerSpec("w0", "/tmp/w0.sock")],
+                           tenant_quota=1)
+    sched.workers["w0"].alive = True
+    sched.admit("t")  # the slot is held by the test
+    gate = sched._tenant_budget("t")
+    order = []
+
+    def waiter(i):
+        sched.admit("t")
+        order.append(i)
+        time.sleep(0.01)
+        sched.release("t")
+
+    threads = []
+    for i in range(3):
+        t = threading.Thread(target=waiter, args=(i,))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5
+        while len(gate._waiters) < i + 1:  # deterministic arrival order
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+    sched.release("t")  # hand the slot to waiter 0
+    for t in threads:
+        t.join(timeout=10)
+    assert order == [0, 1, 2]
+    assert gate.inflight == 0
+
+
+def test_eligible_count_ignores_dead_and_draining():
+    """The no-wait decision rests on this: dead/draining workers are
+    not 'somewhere else to go'."""
+    specs = [WorkerSpec(f"w{i}", f"/tmp/w{i}.sock") for i in range(3)]
+    sched = FleetScheduler(specs)
+    sched.workers["w0"].alive = True
+    sched.workers["w1"].alive = True
+    sched.workers["w1"].draining = True
+    assert sched.eligible_count() == 1
+    assert sched.eligible_count(exclude={"w0"}) == 0
+
+
+def test_peer_map_version_adopted_after_restart(tmp_path):
+    """A restarted front door whose version counter starts over must
+    ADOPT the higher version a worker already holds (its 200 response
+    says applied=false) and republish past it — not believe the
+    worker up to date while it keeps a stale map forever."""
+    server = WorkerServer(str(tmp_path / "w.sock"))
+    thread = server.serve_background()
+    try:
+        # A previous front door left the worker holding map v7.
+        fleet_peers.set_peers(["/tmp/stale-old-worker.sock"], 7)
+        sched = FleetScheduler([WorkerSpec("w0", server.socket_path)],
+                               poll_interval=60)
+        sched.poll_once()  # publish v1 → rejected; adopts v8
+        assert sched._peer_version >= 8
+        sched.poll_once()  # republish at the adopted version → applied
+        assert fleet_peers.peers() == (server.socket_path,)
+        assert fleet_peers.map_version() >= 8
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def test_tenant_label_cardinality_cap():
+    """Tenant strings are client-supplied: past the cap they must
+    aggregate under "other" in every fleet metric series (the PR 8
+    cardinality discipline), while known tenants keep their label."""
+    sched = FleetScheduler([WorkerSpec("w0", "/tmp/w0.sock")],
+                           tenant_quota=1)
+    for i in range(64):
+        assert sched.tenant_label(f"t{i}") == f"t{i}"
+    assert sched.tenant_label("t-overflow") == "other"
+    assert sched.tenant_label("t3") == "t3"  # known tenants keep theirs
+    # The overflow tenant still gets (a shared) quota budget.
+    assert sched._tenant_budget("another-new").limit == 1
+
+
+def test_worker_chunk_endpoint_validates_and_serves(tmp_path):
+    from makisu_tpu.cache import chunks as chunks_mod
+    server = WorkerServer(str(tmp_path / "w.sock"))
+    thread = server.serve_background()
+    try:
+        store = chunks_mod.ChunkStore(str(tmp_path / "chunk-cas"))
+        chunks_mod.register_serving_store(store)
+        import hashlib
+        data = b"peer exchange payload"
+        hex_digest = hashlib.sha256(data).hexdigest()
+        store.put(hex_digest, data)
+
+        def get(path):
+            conn = _UnixHTTPConnection(server.socket_path, 10.0)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        # A registered store the SERVER does not own is not served
+        # (an in-process sibling's bytes must not fake the cross-host
+        # exchange).
+        status, _ = get(f"/chunks/{hex_digest}")
+        assert status == 404
+        server.add_served_chunk_root(str(tmp_path / "chunk-cas"))
+        status, body = get(f"/chunks/{hex_digest}")
+        assert (status, body) == (200, data)
+        status, _ = get("/chunks/" + "0" * 64)
+        assert status == 404
+        status, _ = get("/chunks/../../etc/passwd")
+        assert status == 400
+        status, _ = get("/chunks/ABCD")
+        assert status == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+# -- routing e2e ------------------------------------------------------------
+
+
+def test_affinity_routes_to_session_holder(tmp_path, fleet2):
+    """Build twice through the front door: the second build must land
+    on the worker holding the resident session, as an affinity
+    verdict, and actually hit that session."""
+    ctx = _make_ctx(tmp_path)
+    argv = _build_argv(tmp_path, ctx, fleet2.kv_addr)
+    assert fleet2.client.build(argv, tenant="team-a") == 0
+    first = dict(fleet2.client.last_build)
+    assert first["worker"] in fleet2.workers
+    assert fleet2.client.build(argv, tenant="team-a") == 0
+    second = dict(fleet2.client.last_build)
+    assert second["worker"] == first["worker"]
+    assert second["fleet_verdict"] == "affinity"
+    holder = fleet2.workers[first["worker"]]
+    sessions = holder.session_mgr.stats()
+    assert sessions["count"] == 1
+    assert sessions["hits"] >= 1
+    # The OTHER worker holds no session for this context.
+    for wid, server in fleet2.workers.items():
+        if wid != first["worker"]:
+            assert server.session_mgr.stats()["count"] == 0
+    # The front door reports the routing table.
+    health = fleet2.client.healthz()
+    assert health["fleet"]["route_totals"].get("affinity", 0) >= 1
+
+
+def test_peer_chunk_fetch_hits_before_registry(tmp_path, fleet2):
+    """Drain the session holder: the relocated build KV-hits the
+    shared cache, is missing every chunk locally, and fetches them
+    worker-to-worker — no registry is configured at all, so the peer
+    route is the only way those bytes could have arrived."""
+    g = metrics.global_registry()
+    before_hits = g.counter_total(
+        "makisu_fleet_peer_chunk_hits_total")
+    before_serves = g.counter_total(
+        "makisu_fleet_chunk_serves_total", result="hit")
+    ctx = _make_ctx(tmp_path, "peer-ctx")
+    argv = _build_argv(tmp_path, ctx, fleet2.kv_addr)
+    assert fleet2.client.build(argv, tenant="t") == 0
+    first = dict(fleet2.client.last_build)
+    holder = first["worker"]
+    fleet2.drain(holder)
+    deadline = time.monotonic() + 10
+    while True:
+        workers = {w["id"]: w for w in
+                   fleet2.client.healthz()["fleet"]["workers"]}
+        if workers[holder]["state"] == "draining":
+            break
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert fleet2.client.build(argv, tenant="t") == 0
+    second = dict(fleet2.client.last_build)
+    assert second["worker"] != holder
+    hits = g.counter_total("makisu_fleet_peer_chunk_hits_total")
+    serves = g.counter_total("makisu_fleet_chunk_serves_total",
+                             result="hit")
+    assert hits > before_hits, "no chunk came from a peer"
+    assert serves > before_serves, "no worker served a peer fetch"
+    # Byte identity across the relocation.
+    tag = f"fleet/{ctx.name}:1"
+    d1 = _digests(fleet2.specs[holder].storage, tag)
+    d2 = _digests(fleet2.specs[second["worker"]].storage, tag)
+    assert d1 == d2
+
+
+def test_worker_death_mid_build_fails_over(tmp_path):
+    """Kill a subprocess worker (SIGKILL) while it is mid-build: the
+    front door must fail the build over to the surviving worker and
+    the final digests must equal a direct single-worker build."""
+    ctx = _make_ctx(tmp_path, "failover-ctx")
+    # The RUN step keeps the build busy long enough to kill mid-build.
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY src/ /src/\nRUN sleep 30\n")
+    victim_sock = str(tmp_path / "victim.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    victim = subprocess.Popen(
+        [sys.executable, "-m", "makisu_tpu.cli", "worker",
+         "--socket", victim_sock],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    survivor = WorkerServer(str(tmp_path / "survivor.sock"))
+    survivor.serve_background()
+    kv = SharedKVServer()
+    kv_addr = kv.start()
+    specs = [
+        WorkerSpec("victim", victim_sock,
+                   str(tmp_path / "victim-storage")),
+        WorkerSpec("survivor", survivor.socket_path,
+                   str(tmp_path / "survivor-storage")),
+    ]
+    fleet = FleetServer(str(tmp_path / "fleet.sock"), specs,
+                        poll_interval=0.2)
+    fleet.serve_background()
+    client = WorkerClient(fleet.socket_path)
+    code_box = {}
+    try:
+        deadline = time.monotonic() + 30
+        while not (client.ready()
+                   and WorkerClient(victim_sock).ready()):
+            assert time.monotonic() < deadline, "workers never ready"
+            time.sleep(0.1)
+        # The scheduler must consider the victim alive BEFORE the
+        # survivor is drained, or routing has nowhere to go.
+        deadline = time.monotonic() + 30
+        while True:
+            workers = {w["id"]: w for w in
+                       client.healthz()["fleet"]["workers"]}
+            if workers["victim"]["alive"] \
+                    and workers["survivor"]["alive"]:
+                break
+            assert time.monotonic() < deadline, workers
+            time.sleep(0.1)
+        # Route deterministically to the victim: drain the survivor.
+        conn = _UnixHTTPConnection(fleet.socket_path, 10.0)
+        conn.request("POST", "/drain", body=json.dumps(
+            {"worker": "survivor"}).encode())
+        assert conn.getresponse().status == 200
+        conn.close()
+        argv = ["--log-level", "error", "build", str(ctx),
+                "-t", "fleet/failover:1", "--hasher", "tpu",
+                "--modifyfs", "--root", str(tmp_path / "root"),
+                "--http-cache-addr", kv_addr]
+
+        def submit():
+            code_box["code"] = client.build(argv, tenant="t")
+            code_box["terminal"] = dict(client.last_build)
+
+        builder = threading.Thread(target=submit)
+        builder.start()
+        # Wait until the victim is actually running the build.
+        victim_client = WorkerClient(victim_sock)
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                rows = victim_client.builds().inflight
+            except (OSError, RuntimeError):
+                rows = []
+            if any(r.state == "running" for r in rows):
+                break
+            assert time.monotonic() < deadline, \
+                "build never started on the victim"
+            time.sleep(0.1)
+        # Re-admit the survivor, then kill the victim mid-build.
+        conn = _UnixHTTPConnection(fleet.socket_path, 10.0)
+        conn.request("POST", "/drain", body=json.dumps(
+            {"worker": "survivor", "undrain": True}).encode())
+        assert conn.getresponse().status == 200
+        conn.close()
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        builder.join(timeout=180)
+        assert not builder.is_alive(), "failover never completed"
+        assert code_box["code"] == 0, code_box
+        terminal = code_box["terminal"]
+        assert terminal["worker"] == "survivor"
+        assert terminal["fleet_verdict"] == "failover"
+        assert terminal["fleet_attempts"] >= 2
+        # Digest oracle: a direct build on a fresh worker agrees.
+        (tmp_path / "root2").mkdir(exist_ok=True)
+        oracle = WorkerServer(str(tmp_path / "oracle.sock"))
+        oracle.serve_background()
+        try:
+            oracle_client = WorkerClient(oracle.socket_path)
+            assert oracle_client.build(
+                ["--log-level", "error", "build", str(ctx),
+                 "-t", "fleet/failover:oracle", "--hasher", "tpu",
+                 "--modifyfs", "--root", str(tmp_path / "root2"),
+                 "--storage",
+                 str(tmp_path / "oracle-storage")]) == 0
+        finally:
+            oracle.shutdown()
+            oracle.server_close()
+        got = _digests(str(tmp_path / "survivor-storage"),
+                       "fleet/failover:1")
+        want = _digests(str(tmp_path / "oracle-storage"),
+                        "fleet/failover:oracle")
+        assert got == want, "failover digests diverged from oracle"
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        fleet.shutdown()
+        fleet.server_close()
+        survivor.shutdown()
+        survivor.server_close()
+        kv.stop()
+
+
+def test_no_wait_admission_refusal(tmp_path):
+    """A saturated worker answers the scheduler's no-wait probe with
+    503 instead of queueing."""
+    server = WorkerServer(str(tmp_path / "w.sock"),
+                          max_concurrent_builds=1)
+    thread = server.serve_background()
+    try:
+        server._admission.acquire()  # saturate the only slot
+        conn = _UnixHTTPConnection(server.socket_path, 10.0)
+        try:
+            conn.request(
+                "POST", "/build",
+                body=json.dumps(["version"]).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Makisu-No-Wait": "1"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            body = json.loads(resp.read())
+            assert body["error"] == "admission_refused"
+        finally:
+            conn.close()
+        server._admission.release()
+        # Without the header the same build queues and runs.
+        client = WorkerClient(server.socket_path)
+        assert client.build(["version"]) == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)

@@ -245,3 +245,55 @@ def test_render_top_canned():
     assert "hash" in frame and "75%" in frame
     assert "2.0MiB" in frame           # transfer in-flight
     assert "recent:" in frame and "ok" in frame
+
+
+def test_loadgen_fleet_mode(tmp_path):
+    """Compact ``--fleet`` run (2 workers: the drain phase fires, the
+    kill phase is skipped to keep a routable worker): every build
+    succeeds, the report carries the fleet acceptance surface, and
+    digest identity holds across the drain-forced relocation."""
+    from makisu_tpu.fleet import peers as fleet_peers
+    fleet_peers.reset()
+    report_path = tmp_path / "fleet-report.json"
+    args = _loadgen_args([
+        "--fleet", "--workers", "2", "--contexts", "2",
+        "--rounds", "3", "--files", "3", "--file-kb", "1",
+        "--tenants", "red,blue", "--tenant-quota", "1",
+        "--poll-interval", "0.1",
+        "--report", str(report_path),
+        "--work-dir", str(tmp_path / "work"),
+    ])
+    try:
+        assert loadgen.run(args) == 0
+    finally:
+        fleet_peers.reset()
+    report = json.loads(report_path.read_text())
+    assert report["schema"] == "makisu-tpu.loadgen.v1"
+    assert report["mode"] == "fleet"
+    # 2 contexts x 3 rounds, twice (baseline + fleet phase).
+    assert report["builds"] == 6
+    assert report["failures"] == 0
+    assert len(report["baseline_results"]) == 6
+    fleet = report["fleet"]
+    # Affinity: round 1 must route back to each context's session
+    # holder (the drain lands only between rounds 1 and 2).
+    assert fleet["affinity_hit_rate_eligible"] >= 0.5
+    assert fleet["route_totals"].get("affinity", 0) >= 1
+    # The drain relocated context 0's round-2 build...
+    assert fleet["disruption"]["drained"]
+    assert fleet["relocated_builds"] >= 1
+    # ...whose chunks arrived worker-to-worker (no registry exists in
+    # this topology, so peers are the only possible source)...
+    assert fleet["peer_chunk_hits"] >= 1
+    assert fleet["peer_chunk_bytes"] > 0
+    # ...with byte-identical layer digests.
+    assert fleet["digest_identity"]
+    assert fleet["digest_mismatches"] == []
+    # Distribution covers both workers; baseline comparison present.
+    assert len(fleet["distribution"]) == 2
+    assert fleet["baseline"]["latency_seconds"]["count"] == 6
+    assert "p99_delta_seconds" in fleet
+    # Both tenants flowed through the front door.
+    tenants = report["tenant_latency_seconds"]
+    assert {t for t, s in tenants.items() if s.get("count")} \
+        == {"red", "blue"}
