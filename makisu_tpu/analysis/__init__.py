@@ -1,0 +1,43 @@
+"""Repo-invariant static analysis (`makisu-tpu check`).
+
+The rule engine (:mod:`engine`) + six rules distilled from shipped
+bugs (:mod:`rules`), with per-line ``# check: allow(<rule>)`` pragmas
+and a committed ``baseline.json`` so pre-existing findings never block
+while new ones fail CI. See docs/ANALYSIS.md for the catalog.
+"""
+
+from __future__ import annotations
+
+import os
+
+from makisu_tpu.analysis.engine import (BASELINE_SCHEMA, Finding,
+                                        FileContext, Rule,
+                                        apply_baseline, load_baseline,
+                                        run_check, write_baseline)
+from makisu_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = [
+    "BASELINE_SCHEMA", "Finding", "FileContext", "Rule", "ALL_RULES",
+    "apply_baseline", "default_baseline_path", "default_rules",
+    "default_scan_paths", "load_baseline", "run_check",
+    "write_baseline", "repo_root",
+]
+
+
+def repo_root() -> str:
+    """The checkout root (parent of the makisu_tpu package) — what
+    finding paths and the committed baseline are relative to."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_scan_paths() -> list[str]:
+    """What `makisu-tpu check` scans by default: the product package.
+    Tests/fixtures deliberately excluded — they contain intentional
+    violations."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")

@@ -103,7 +103,7 @@ class BuildNode:
                                   digest=hex_digest[:12], replay=True):
                     memfs.replay_layer(ops, chain_key=hex_digest)
                 metrics.counter_add(
-                    "makisu_cached_layers_applied_total")
+                    metrics.CACHED_LAYERS_APPLIED_TOTAL)
                 return
         log.info("applying cached layer %s (unpack=%s)", hex_digest,
                  modify_fs)
@@ -130,7 +130,7 @@ class BuildNode:
         if record is not None:
             session.replay_store(memo_key, record)
         # After the span: a failed application must not count.
-        metrics.counter_add("makisu_cached_layers_applied_total")
+        metrics.counter_add(metrics.CACHED_LAYERS_APPLIED_TOTAL)
 
     def pull_cache_layer(self, cache_mgr) -> bool:
         """Try to prefetch this node's layer. A miss or failure returns

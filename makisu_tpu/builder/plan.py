@@ -112,7 +112,7 @@ class BuildPlan:
             curr = stage
             log.info("stage %d/%d: %s", k + 1, len(self.stages), stage)
             with metrics.span("stage", alias=stage.alias, index=k):
-                metrics.counter_add("makisu_stages_total")
+                metrics.counter_add(metrics.STAGES_TOTAL)
                 with metrics.span("pull_cache_layers"):
                     stage.pull_cache_layers(self.cache_mgr)
                 last_stage = k == len(self.stages) - 1

@@ -240,6 +240,7 @@ class ChunkStore:
         from makisu_tpu.utils import concurrency
         # Plain submit (no context copy): the probe touches no
         # telemetry, and a copy per batch on the hot path buys nothing.
+        # check: allow(ctx-propagation)
         concurrency.hash_pool().submit(probe)
 
     def _exists_cached(self, hex_digest: str,

@@ -14,7 +14,7 @@ import threading
 import time
 import urllib.request
 
-from makisu_tpu.utils import metrics
+from makisu_tpu.utils import fileio, metrics
 
 
 class MemoryStore:
@@ -59,11 +59,13 @@ class FSStore:
                 self._data[key] = (value, ts)
 
     def _persist_locked(self) -> None:
-        tmp = self.path + ".tmp"
+        # Atomic + fsynced (unique temp, rename): a SIGTERM mid-save
+        # must not truncate the whole KV file — every cached entry of
+        # every build sharing this storage dir dies with it. The old
+        # fixed ".tmp" name also cross-clobbered under concurrent
+        # writers; write_json_atomic's pid+tid temp name cannot.
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(self._data, f)
-        os.rename(tmp, self.path)
+        fileio.write_json_atomic(self.path, self._data)
 
     def get(self, key: str) -> str | None:
         with self._lock:

@@ -402,6 +402,28 @@ def make_parser() -> argparse.ArgumentParser:
                               "(irreducible vs cache-avoidable wall "
                               "time per phase)")
 
+    check = sub.add_parser(
+        "check", help="repo-invariant static analysis: the six rules "
+                      "distilled from shipped bugs (see "
+                      "docs/ANALYSIS.md); exits 1 on any finding not "
+                      "in the committed baseline")
+    check.add_argument("paths", nargs="*", metavar="PATH",
+                       help="files/directories to scan (default: the "
+                            "makisu_tpu package)")
+    check.add_argument("--json", action="store_true", dest="json_out",
+                       help="machine-readable output: one JSON object "
+                            "with findings/suppressed/baseline (the CI "
+                            "gate's artifact)")
+    check.add_argument("--update-baseline", action="store_true",
+                       help="rewrite the baseline to the current "
+                            "finding set (review the diff!) and exit 0")
+    check.add_argument("--baseline", default="", metavar="FILE",
+                       help="baseline file (default: the committed "
+                            "makisu_tpu/analysis/baseline.json)")
+    check.add_argument("--rule", action="append", default=[],
+                       metavar="NAME",
+                       help="run only this rule (repeatable)")
+
     doctor = sub.add_parser(
         "doctor", help="diagnose a failure-forensics bundle, or the "
                        "device route (--device)")
@@ -1001,6 +1023,65 @@ def cmd_doctor(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Run the static-analysis rule engine over the tree: six rules
+    distilled from shipped bugs (ctx propagation, signal safety,
+    metric-name registry, atomic durable writes, silent swallows,
+    unbounded I/O). Pre-existing findings live in the committed
+    baseline; anything new exits 1 naming the rule, file, and line."""
+    import json as json_mod
+
+    from makisu_tpu import analysis
+
+    rules = analysis.default_rules()
+    if args.rule:
+        wanted = set(args.rule)
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            raise SystemExit(
+                f"unknown rule(s) {', '.join(sorted(unknown))}; "
+                f"valid: {', '.join(sorted(known))}")
+        rules = [r for r in rules if r.name in wanted]
+    paths = args.paths or analysis.default_scan_paths()
+    root = analysis.repo_root()
+    baseline_path = args.baseline or analysis.default_baseline_path()
+    if args.update_baseline and not args.baseline \
+            and (args.rule or args.paths):
+        # write_baseline REPLACES the file with the current finding
+        # set; updating the committed repo baseline from a filtered
+        # scan would silently discard every other rule's/path's
+        # entries. An explicit --baseline names a file the caller
+        # owns, so partial scopes are fine there.
+        raise SystemExit(
+            "--update-baseline with --rule/PATH filters would drop "
+            "every unscanned finding from the committed baseline; "
+            "run it unfiltered, or pass an explicit --baseline FILE")
+    findings = analysis.run_check(paths, rules, root=root)
+    if args.update_baseline:
+        analysis.write_baseline(baseline_path, findings)
+        log.info("baseline updated: %d finding(s) recorded in %s",
+                 len(findings), baseline_path)
+        return 0
+    baseline = analysis.load_baseline(baseline_path)
+    new, suppressed = analysis.apply_baseline(findings, baseline)
+    if args.json_out:
+        print(json_mod.dumps({
+            "schema": "makisu-tpu.check.v1",
+            "findings": [f.to_dict() for f in new],
+            "suppressed": suppressed,
+            "baseline": os.path.relpath(baseline_path, root)
+            if baseline_path.startswith(root) else baseline_path,
+            "rules": sorted(r.name for r in rules),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        print(f"makisu-tpu check: {len(new)} new finding(s), "
+              f"{suppressed} baseline-suppressed")
+    return 1 if new else 0
+
+
 def cmd_worker(args) -> int:
     from makisu_tpu.utils import flightrecorder
     from makisu_tpu.utils import metrics as metrics_mod
@@ -1148,8 +1229,8 @@ def main(argv: list[str] | None = None) -> int:
                 "diff": cmd_diff, "worker": cmd_worker,
                 "fleet": cmd_fleet, "report": cmd_report,
                 "doctor": cmd_doctor, "explain": cmd_explain,
-                "top": cmd_top, "loadgen": cmd_loadgen,
-                "history": cmd_history}
+                "check": cmd_check, "top": cmd_top,
+                "loadgen": cmd_loadgen, "history": cmd_history}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -1187,7 +1268,7 @@ def main(argv: list[str] | None = None) -> int:
     # label.
     from makisu_tpu import native as _native
     metrics.gauge_set(
-        "makisu_build_info", 1,
+        metrics.BUILD_INFO, 1,
         version=makisu_tpu.__version__,
         command=args.command or "",
         hasher=getattr(args, "hasher", "") or "",

@@ -30,8 +30,14 @@ class DockerClient:
         self.socket_path = host[len("unix://"):]
         self.version = version
 
+    # A multi-GB image load into a busy daemon is legitimately slow;
+    # the bound exists so a hung dockerd fails the build instead of
+    # wedging it (the `check` unbounded-io invariant).
+    LOAD_TIMEOUT = 600.0
+
     def image_tar_load(self, tar_path: str) -> None:
-        conn = _UnixHTTPConnection(self.socket_path)
+        conn = _UnixHTTPConnection(self.socket_path,
+                                   timeout=self.LOAD_TIMEOUT)
         try:
             with open(tar_path, "rb") as f:
                 conn.request(

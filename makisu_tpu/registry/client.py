@@ -446,14 +446,14 @@ class RegistryClient:
             # Bytes crossed the wire whether or not the digest checks
             # out — count before the mismatch raise.
             if streamed:
-                metrics.counter_add("makisu_registry_bytes_total",
+                metrics.counter_add(metrics.REGISTRY_BYTES_TOTAL,
                                     os.path.getsize(tmp),
                                     direction="pull")
             if actual != hex_digest:
                 raise ValueError(
                     f"pulled blob digest mismatch for {digest}: "
                     f"got sha256:{actual}")
-            metrics.counter_add("makisu_registry_blobs_total",
+            metrics.counter_add(metrics.REGISTRY_BLOBS_TOTAL,
                                 direction="pull")
             events.emit("registry_blob", direction="pull",
                         digest=hex_digest,
@@ -537,7 +537,7 @@ class RegistryClient:
         # Count before the length check: truncated bodies still
         # crossed the wire, and failure episodes are exactly when
         # transfer volume matters.
-        metrics.counter_add("makisu_registry_bytes_total", nbytes,
+        metrics.counter_add(metrics.REGISTRY_BYTES_TOTAL, nbytes,
                             direction="pull")
         if resp.status == 206 and nbytes != end - start:
             return None
@@ -634,7 +634,7 @@ class RegistryClient:
             except HTTPError as e:
                 if e.status < 500 or attempt == self.config.retries - 1:
                     raise
-                metrics.counter_add("makisu_registry_retries_total",
+                metrics.counter_add(metrics.REGISTRY_RETRIES_TOTAL,
                                     op="push_layer")
                 time.sleep(backoff)
                 backoff *= 2
@@ -667,14 +667,14 @@ class RegistryClient:
                 # Bytes-pushed counts the attempt (the body goes on the
                 # wire before a failure status comes back); blobs-pushed
                 # counts completions.
-                metrics.counter_add("makisu_registry_bytes_total",
+                metrics.counter_add(metrics.REGISTRY_BYTES_TOTAL,
                                     len(body), direction="push")
                 self._send("PUT", f"{location}{sep}digest={digest}",
                            headers={"Content-Type":
                                     "application/octet-stream",
                                     "Content-Length": str(len(body))},
                            body=body, accepted=(201, 204))
-            metrics.counter_add("makisu_registry_blobs_total",
+            metrics.counter_add(metrics.REGISTRY_BLOBS_TOTAL,
                                 direction="push")
             events.emit("registry_blob", direction="push",
                         digest=digest.hex(), bytes=len(body),
@@ -690,7 +690,7 @@ class RegistryClient:
                 with budget.reserve(min(step, size - off)):
                     piece = f.read(step)
                     self._limiter.wait(len(piece))
-                    metrics.counter_add("makisu_registry_bytes_total",
+                    metrics.counter_add(metrics.REGISTRY_BYTES_TOTAL,
                                         len(piece), direction="push")
                     resp = self._send(
                         "PATCH", location,
@@ -707,7 +707,7 @@ class RegistryClient:
         sep = "&" if "?" in location else "?"
         self._send("PUT", f"{location}{sep}digest={digest}",
                    accepted=(201, 204))
-        metrics.counter_add("makisu_registry_blobs_total",
+        metrics.counter_add(metrics.REGISTRY_BLOBS_TOTAL,
                             direction="push")
         events.emit("registry_blob", direction="push",
                     digest=digest.hex(), bytes=size,

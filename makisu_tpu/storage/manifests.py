@@ -12,6 +12,7 @@ import threading
 import time
 
 from makisu_tpu.docker.image import DistributionManifest, ImageName
+from makisu_tpu.utils import fileio
 
 
 class ManifestStore:
@@ -28,11 +29,11 @@ class ManifestStore:
     def save(self, name: ImageName, manifest: DistributionManifest) -> str:
         p = self._path(name)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + ".tmp"
         with self._lock:
-            with open(tmp, "w") as f:
-                json.dump(manifest.to_json(), f)
-            os.rename(tmp, p)
+            # Atomic + fsynced: a SIGTERM between the old tmp-write and
+            # rename left a torn manifest for the tag — the image looks
+            # pushed/saved but cannot be loaded.
+            fileio.write_json_atomic(p, manifest.to_json())
             self._evict_locked()
         return p
 

@@ -215,11 +215,11 @@ class Transport:
             if retry_stale:
                 raise _StaleConnection() from None
             raise
-        metrics.counter_add("makisu_http_requests_total")
+        metrics.counter_add(metrics.HTTP_REQUESTS_TOTAL)
         if fresh:
             # request() opened the socket lazily; count the handshake
             # only once it actually happened.
-            metrics.counter_add("makisu_http_connections_total",
+            metrics.counter_add(metrics.HTTP_CONNECTIONS_TOTAL,
                                 scheme=scheme)
         try:
             resp = conn.getresponse()

@@ -81,6 +81,40 @@ FLEET_PEER_MAP_VERSION = "makisu_fleet_peer_map_version"
 FLEET_CHUNK_SERVES = "makisu_fleet_chunk_serves_total"
 FLEET_CHUNK_SERVE_BYTES = "makisu_fleet_chunk_serve_bytes_total"
 
+# Deploy-identity info gauge (cli.main): constant 1, identity in the
+# labels — the node_exporter "build_info" idiom.
+BUILD_INFO = "makisu_build_info"
+
+# Registry transfer plane (registry/client.py): bytes/blobs count the
+# wire in both directions; retries label the retried operation.
+REGISTRY_BYTES_TOTAL = "makisu_registry_bytes_total"
+REGISTRY_BLOBS_TOTAL = "makisu_registry_blobs_total"
+REGISTRY_RETRIES_TOTAL = "makisu_registry_retries_total"
+
+# HTTP transport (utils/httputil.py): requests vs fresh connections —
+# the keep-alive reuse ratio CI's transfer smoke asserts on.
+HTTP_REQUESTS_TOTAL = "makisu_http_requests_total"
+HTTP_CONNECTIONS_TOTAL = "makisu_http_connections_total"
+
+# Process resource gauges (utils/resources.py sampler): what the
+# worker's /metrics scrape sees between builds.
+PROCESS_RSS_BYTES = "makisu_process_rss_bytes"
+PROCESS_CPU_SECONDS = "makisu_process_cpu_seconds"
+PROCESS_THREADS = "makisu_process_threads"
+PROCESS_OPEN_FDS = "makisu_process_open_fds"
+PROCESS_IO_READ_BYTES = "makisu_process_io_read_bytes"
+PROCESS_IO_WRITE_BYTES = "makisu_process_io_write_bytes"
+
+# Build-plan execution (builder/plan.py, builder/node.py).
+STAGES_TOTAL = "makisu_stages_total"
+CACHED_LAYERS_APPLIED_TOTAL = "makisu_cached_layers_applied_total"
+
+# Resident build sessions (worker/session.py): reuse hits, dirty-set
+# invalidations by reason, and resident memo bytes per context.
+SESSION_HITS = "makisu_session_hits"
+SESSION_INVALIDATIONS = "makisu_session_invalidations_total"
+SESSION_RESIDENT_BYTES = "makisu_session_resident_bytes"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.
@@ -237,6 +271,10 @@ class MetricsRegistry:
     def counter_add(self, name: str, value: float = 1.0,
                     **labels: Any) -> None:
         key = _label_key(labels)
+        # Signal-context callers (FlightRecorder.dump) PROBE this lock
+        # with a timeout first and skip the bump when it is held — see
+        # the `for reg in metrics._targets()` guard in dump().
+        # check: allow(signal-safety)
         with self._lock:
             series = self._counters.setdefault(name, {})
             series[key] = series.get(key, 0.0) + value
@@ -336,6 +374,10 @@ class MetricsRegistry:
                 for name, series in sorted(table.items())
             }
 
+        # Signal-context callers reach report() only through
+        # flightrecorder._metrics_snapshot, which probes this lock with
+        # a timeout and ships the bundle without a metrics section when
+        # it is held.  # check: allow(signal-safety)
         with self._lock:
             hists = {
                 name: [{

@@ -128,15 +128,15 @@ class ResourceSampler:
         sample = read_sample()
         self._trajectory.append(sample)
         g = metrics.global_registry()
-        g.gauge_set("makisu_process_rss_bytes", sample["rss_bytes"])
-        g.gauge_set("makisu_process_cpu_seconds", sample["cpu_seconds"])
-        g.gauge_set("makisu_process_threads", sample["threads"])
+        g.gauge_set(metrics.PROCESS_RSS_BYTES, sample["rss_bytes"])
+        g.gauge_set(metrics.PROCESS_CPU_SECONDS, sample["cpu_seconds"])
+        g.gauge_set(metrics.PROCESS_THREADS, sample["threads"])
         if "open_fds" in sample:
-            g.gauge_set("makisu_process_open_fds", sample["open_fds"])
+            g.gauge_set(metrics.PROCESS_OPEN_FDS, sample["open_fds"])
         if "io_read_bytes" in sample:
-            g.gauge_set("makisu_process_io_read_bytes",
+            g.gauge_set(metrics.PROCESS_IO_READ_BYTES,
                         sample["io_read_bytes"])
-            g.gauge_set("makisu_process_io_write_bytes",
+            g.gauge_set(metrics.PROCESS_IO_WRITE_BYTES,
                         sample["io_write_bytes"])
         cpu_delta = 0.0
         if self._last_cpu is not None:

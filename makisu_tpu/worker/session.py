@@ -61,10 +61,11 @@ from makisu_tpu.utils import logging as log
 # own name; resolve the MODULE explicitly.
 walk_mod = importlib.import_module("makisu_tpu.snapshot.walk")
 
-# Metric names (satellite: session telemetry).
-SESSION_HITS = "makisu_session_hits"
-SESSION_INVALIDATIONS = "makisu_session_invalidations_total"
-SESSION_RESIDENT_BYTES = "makisu_session_resident_bytes"
+# Session metric names live in the utils/metrics.py registry (the
+# `check` metric-registry invariant: one spelling per series).
+SESSION_HITS = metrics.SESSION_HITS
+SESSION_INVALIDATIONS = metrics.SESSION_INVALIDATIONS
+SESSION_RESIDENT_BYTES = metrics.SESSION_RESIDENT_BYTES
 
 # Rough per-unit resident-byte estimates for the /healthz accounting.
 # Exact sizes would need sys.getsizeof walks per build; the budget is a

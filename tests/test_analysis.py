@@ -1,0 +1,406 @@
+"""Tests for `makisu-tpu check` (makisu_tpu/analysis/).
+
+Three tiers, mirroring the gate's contract:
+
+- fixture snippets that trigger each of the six rules, plus the
+  pragma-suppressed and baseline-suppressed variant of each;
+- a repo-wide self-scan asserting ZERO unbaselined findings (the exact
+  invariant CI enforces — a PR that introduces a violation fails here
+  first);
+- baseline round-trips: `--update-baseline` then a clean exit 0, and
+  the count semantics (a second identical violation surfaces past a
+  baseline recording one).
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from makisu_tpu import analysis
+from makisu_tpu import cli
+
+
+def scan(tmp_path, source: str, name: str = "snippet.py"):
+    (tmp_path / name).write_text(textwrap.dedent(source))
+    return analysis.run_check([str(tmp_path)],
+                              analysis.default_rules(),
+                              root=str(tmp_path))
+
+
+def rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# One (rule, violating source, pragma'd source) triple per rule. The
+# pragma variant must differ ONLY by the `# check: allow(...)` comment.
+FIXTURES = [
+    ("ctx-propagation", """\
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """, """\
+        import threading
+
+        def spawn(fn):
+            # check: allow(ctx-propagation)
+            threading.Thread(target=fn, daemon=True).start()
+        """),
+    ("signal-safety", """\
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _dump_bundle():
+            with _lock:
+                return 1
+
+        def handler(signum, frame):
+            _dump_bundle()
+
+        signal.signal(signal.SIGTERM, handler)
+        """, """\
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _dump_bundle():
+            # check: allow(signal-safety)
+            with _lock:
+                return 1
+
+        def handler(signum, frame):
+            _dump_bundle()
+
+        signal.signal(signal.SIGTERM, handler)
+        """),
+    ("metric-registry", """\
+        from makisu_tpu.utils import metrics
+
+        def bump():
+            metrics.counter_add("makisu_bogus_total")
+        """, """\
+        from makisu_tpu.utils import metrics
+
+        def bump():
+            # check: allow(metric-registry)
+            metrics.counter_add("makisu_bogus_total")
+        """),
+    ("atomic-write", """\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """, """\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                # check: allow(atomic-write)
+                json.dump(payload, f)
+        """),
+    ("silent-swallow", """\
+        def quiet(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+        """, """\
+        def quiet(fn):
+            try:
+                fn()
+            # check: allow(silent-swallow)
+            except Exception:
+                pass
+        """),
+    ("unbounded-io", """\
+        import socket
+
+        def dial(host):
+            return socket.create_connection((host, 80))
+        """, """\
+        import socket
+
+        def dial(host):
+            # check: allow(unbounded-io)
+            return socket.create_connection((host, 80))
+        """),
+]
+
+
+@pytest.mark.parametrize("rule,bad,pragmad",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_rule_fires_and_names_the_site(tmp_path, rule, bad, pragmad):
+    findings = scan(tmp_path, bad)
+    ours = [f for f in findings if f.rule == rule]
+    assert ours, f"rule {rule} did not fire: {findings}"
+    f = ours[0]
+    # The acceptance contract: rule, file, and line are all named.
+    assert f.path == "snippet.py"
+    assert f.line >= 1
+    assert f.snippet in textwrap.dedent(bad)
+    assert rule in f.render() and "snippet.py" in f.render()
+
+
+@pytest.mark.parametrize("rule,bad,pragmad",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_pragma_suppresses(tmp_path, rule, bad, pragmad):
+    findings = scan(tmp_path, pragmad)
+    assert rule not in rules_hit(findings), findings
+
+
+@pytest.mark.parametrize("rule,bad,pragmad",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_baseline_suppresses(tmp_path, rule, bad, pragmad):
+    findings = scan(tmp_path, bad)
+    baseline_path = tmp_path / "baseline.json"
+    analysis.write_baseline(str(baseline_path), findings)
+    rerun = scan(tmp_path, bad)
+    new, suppressed = analysis.apply_baseline(
+        rerun, analysis.load_baseline(str(baseline_path)))
+    assert new == []
+    assert suppressed == len(findings) > 0
+
+
+def test_baseline_counts_cap_occurrences(tmp_path):
+    one = """\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """
+    findings = scan(tmp_path, one)
+    baseline_path = tmp_path / "baseline.json"
+    analysis.write_baseline(str(baseline_path), findings)
+    # A SECOND identical violation (same stripped line text, new line)
+    # must surface past the count the baseline recorded.
+    two = one + """\
+
+        def save_again(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """
+    rerun = scan(tmp_path, two)
+    new, suppressed = analysis.apply_baseline(
+        rerun, analysis.load_baseline(str(baseline_path)))
+    assert suppressed == 1
+    assert [f.rule for f in new] == ["atomic-write"]
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    source = """\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """
+    analysis.write_baseline(str(tmp_path / "b.json"),
+                            scan(tmp_path, source))
+    shifted = "# a new header comment\n\n" + textwrap.dedent(source)
+    (tmp_path / "snippet.py").write_text(shifted)
+    rerun = analysis.run_check([str(tmp_path)],
+                               analysis.default_rules(),
+                               root=str(tmp_path))
+    new, _ = analysis.apply_baseline(
+        rerun, analysis.load_baseline(str(tmp_path / "b.json")))
+    assert new == [], "line drift must not invalidate the baseline"
+
+
+def test_stdlib_http_connection_positional_pair_still_flagged(tmp_path):
+    # (host, port) is NOT a timeout; only the repo's _Unix* subclasses
+    # take (path, timeout) positionally.
+    findings = scan(tmp_path, """\
+        import http.client
+
+        def dial(host):
+            return http.client.HTTPConnection(host, 8080)
+
+        def dial_unix(path):
+            return _UnixHTTPConnection(path, 5.0)
+        """)
+    ours = [f for f in findings if f.rule == "unbounded-io"]
+    assert len(ours) == 1 and "HTTPConnection" in ours[0].message
+
+
+def test_explicit_non_py_file_fails_the_gate(tmp_path):
+    (tmp_path / "README.md").write_text("# not python\n")
+    findings = analysis.run_check([str(tmp_path / "README.md")],
+                                  analysis.default_rules(),
+                                  root=str(tmp_path))
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert "not a .py file" in findings[0].message
+
+
+def test_missing_scan_path_fails_the_gate(tmp_path):
+    findings = analysis.run_check([str(tmp_path / "no_such_dir")],
+                                  analysis.default_rules(),
+                                  root=str(tmp_path))
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert "does not exist" in findings[0].message
+
+
+def test_signal_safety_same_named_defs_both_tracked(tmp_path):
+    # Two same-named functions in one module: the hazard in the FIRST
+    # must not be overwritten by the second definition's (empty) scan.
+    findings = scan(tmp_path, """\
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        class Recorder:
+            def _dump_bundle(self):
+                with _lock:
+                    return 1
+
+        def _dump_bundle():
+            return 2
+
+        def handler(signum, frame):
+            Recorder()._dump_bundle()
+
+        signal.signal(signal.SIGTERM, handler)
+        """)
+    assert "signal-safety" in rules_hit(findings), findings
+
+
+def test_signal_safety_skips_nested_closure_bodies(tmp_path):
+    # The closure's lock belongs to the closure; it is only handed to
+    # a pool, never called from the handler, so nothing is reachable.
+    findings = scan(tmp_path, """\
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _dump_bundle(pool):
+            def worker():
+                with _lock:
+                    return 1
+            pool.defer(worker)
+
+        def handler(signum, frame):
+            _dump_bundle(None)
+
+        signal.signal(signal.SIGTERM, handler)
+        """)
+    assert "signal-safety" not in rules_hit(findings), findings
+
+
+def test_cli_refuses_filtered_default_baseline_update(tmp_path):
+    with pytest.raises(SystemExit, match="unscanned"):
+        cli.main(["--log-level", "error", "check", str(tmp_path),
+                  "--update-baseline"])
+
+
+def test_syntax_error_becomes_parse_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    findings = analysis.run_check([str(tmp_path)],
+                                  analysis.default_rules(),
+                                  root=str(tmp_path))
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_metric_registry_accepts_constants_and_aliases(tmp_path):
+    findings = scan(tmp_path, """\
+        from makisu_tpu.utils import metrics
+
+        STAGES = metrics.STAGES_TOTAL
+
+        def ok():
+            metrics.counter_add(metrics.FLEET_ROUTE_TOTAL, verdict="x")
+            metrics.counter_add(STAGES)
+
+        def unknown():
+            metrics.counter_add(metrics.NOT_A_REGISTERED_NAME)
+        """)
+    ours = [f for f in findings if f.rule == "metric-registry"]
+    assert len(ours) == 1
+    assert "NOT_A_REGISTERED_NAME" in ours[0].message
+
+
+def test_uncapped_tenant_label_flagged(tmp_path):
+    findings = scan(tmp_path, """\
+        from makisu_tpu.utils import metrics
+
+        def record(tenant):
+            metrics.counter_add(metrics.FLEET_ROUTE_TOTAL,
+                                tenant=tenant)
+
+        def capped(scheduler, tenant):
+            metrics.counter_add(metrics.FLEET_ROUTE_TOTAL,
+                                tenant=scheduler.tenant_label(tenant))
+        """)
+    ours = [f for f in findings if f.rule == "metric-registry"]
+    assert len(ours) == 1
+    assert "tenant" in ours[0].message
+
+
+def test_repo_self_scan_zero_unbaselined():
+    """The CI gate's exact invariant: the shipped tree plus the
+    committed baseline has nothing new to report."""
+    findings = analysis.run_check(analysis.default_scan_paths(),
+                                  analysis.default_rules(),
+                                  root=analysis.repo_root())
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new, _suppressed = analysis.apply_baseline(findings, baseline)
+    assert new == [], "unbaselined findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_cli_round_trip_and_json(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(textwrap.dedent("""\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """))
+    baseline = tmp_path / "baseline.json"
+    argv = ["--log-level", "error", "check", str(tmp_path),
+            "--baseline", str(baseline)]
+    # No baseline yet: the finding fails the gate, and --json names
+    # the rule, file, and line machine-readably.
+    assert cli.main(argv + ["--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schema"] == "makisu-tpu.check.v1"
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "atomic-write"
+    assert finding["path"].endswith("bad.py")
+    assert finding["line"] == 5
+    # --update-baseline records it; the rerun is clean exit 0 with the
+    # finding accounted as suppressed.
+    assert cli.main(argv + ["--update-baseline"]) == 0
+    assert baseline.is_file()
+    assert cli.main(argv + ["--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] == []
+    assert payload["suppressed"] == 1
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(textwrap.dedent("""\
+        import json
+
+        def quiet(path, payload):
+            try:
+                with open(path, "w") as f:
+                    json.dump(payload, f)
+            except Exception:
+                pass
+        """))
+    argv = ["--log-level", "error", "check", str(tmp_path),
+            "--baseline", str(tmp_path / "none.json"), "--json"]
+    assert cli.main(argv + ["--rule", "silent-swallow"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in payload["findings"]} == {"silent-swallow"}
+    with pytest.raises(SystemExit):
+        cli.main(argv + ["--rule", "not-a-rule"])
