@@ -1006,6 +1006,130 @@ def _transfer_micro() -> dict:
     }
 
 
+def _serve_micro() -> dict:
+    """Distribution-plane micro-bench: build v1 (recipes published),
+    serve it, seed a client with a cold delta pull, 1-edit rebuild,
+    then measure the DELTA pull of v2 against a cold FULL pull of v2 —
+    bytes over the wire and wall seconds for each, with every
+    reconstituted layer digest asserted byte-identical. The
+    delta-vs-full byte ratio is the ROADMAP item 3 acceptance number
+    (<10% on a 1-edit image). Pure CPU + unix socket, a few seconds.
+    MAKISU_BENCH_SERVE=0 skips the section."""
+    import shutil
+    import tempfile
+
+    from makisu_tpu.builder import BuildPlan
+    from makisu_tpu.cache import CacheManager, MemoryStore
+    from makisu_tpu.cache.chunks import attach_chunk_dedup
+    from makisu_tpu.chunker import TPUHasher
+    from makisu_tpu.context import BuildContext
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.dockerfile import parse_file
+    from makisu_tpu.registry import RegistryClient, RegistryFixture
+    from makisu_tpu.serve import ServeServer, pull_image_delta
+    from makisu_tpu.storage import ImageStore
+
+    tmp = tempfile.mkdtemp(prefix="bench-serve-")
+    # Publishing on for THIS section only — the flag must not leak
+    # recipe-publish cost into later sections' timings, so everything
+    # after the env snapshot (including setup that can raise) runs
+    # under the restoring finally.
+    env_before = os.environ.get("MAKISU_TPU_SERVE")
+    server = None
+    try:
+        os.environ["MAKISU_TPU_SERVE"] = "1"
+        kv = MemoryStore()
+        fixture = RegistryFixture()
+        builder_storage = os.path.join(tmp, "builder-storage")
+        rng = np.random.default_rng(11)
+        v1 = rng.integers(0, 256, size=24 * 1024 * 1024,
+                          dtype=np.uint8).tobytes()
+        v2 = v1[:40_000] + b"ONE-EDIT" + v1[40_000:]
+
+        def build_and_push(tag: str, payload: bytes) -> None:
+            ctx_dir = os.path.join(tmp, f"ctx-{tag}")
+            os.makedirs(ctx_dir, exist_ok=True)
+            with open(os.path.join(ctx_dir, "blob.bin"), "wb") as f:
+                f.write(payload)
+            root = os.path.join(tmp, f"root-{tag}")
+            os.makedirs(root, exist_ok=True)
+            store = ImageStore(builder_storage)
+            client = RegistryClient(store, "bench.test", "bench/serve",
+                                    transport=fixture)
+            ctx = BuildContext(root, ctx_dir, store, hasher=TPUHasher(),
+                               sync_wait=0.0)
+            mgr = CacheManager(kv, store, registry_client=client)
+            attach_chunk_dedup(mgr,
+                               os.path.join(builder_storage, "chunks"))
+            name = ImageName("bench.test", "bench/serve", tag)
+            plan = BuildPlan(
+                ctx, name, [], mgr,
+                parse_file("FROM scratch\nCOPY blob.bin /blob.bin\n"),
+                allow_modify_fs=False, force_commit=True)
+            plan.execute()
+            mgr.wait_for_push()
+            push_client = RegistryClient(store, "bench.test",
+                                         "bench/serve",
+                                         transport=fixture)
+            push_client.materialize_blob = mgr.materialize
+            mgr.materialize_pending()
+            push_client.push(name)
+
+        build_and_push("v1", v1)
+        sock = os.path.join(tmp, "serve.sock")
+        server = ServeServer(sock, builder_storage)
+        server.serve_background()
+        cstore = ImageStore(os.path.join(tmp, "client-storage"))
+        creg = RegistryClient(cstore, "bench.test", "bench/serve",
+                              transport=fixture)
+        pull_image_delta(creg, cstore,
+                         ImageName("bench.test", "bench/serve", "v1"),
+                         sock)  # seeds the client chunk CAS
+        build_and_push("v2", v2)
+        n2 = ImageName("bench.test", "bench/serve", "v2")
+        t0 = time.perf_counter()
+        _, rep = pull_image_delta(creg, cstore, n2, sock)
+        delta_seconds = time.perf_counter() - t0
+        ostore = ImageStore(os.path.join(tmp, "oracle-storage"))
+        oreg = RegistryClient(ostore, "bench.test", "bench/serve",
+                              transport=fixture)
+        t0 = time.perf_counter()
+        manifest = oreg.pull(n2)
+        full_seconds = time.perf_counter() - t0
+        identical = True
+        for desc in manifest.layers:
+            hx = desc.digest.hex()
+            with ostore.layers.open(hx) as fa, \
+                    cstore.layers.open(hx) as fb:
+                if fa.read() != fb.read():
+                    identical = False
+        return {
+            "image_mb": round(len(v1) / (1 << 20), 1),
+            "delta_bytes_fetched": rep["bytes_fetched"],
+            "full_image_bytes": rep["bytes_full_image"],
+            "fetched_fraction": rep["fetched_fraction"],
+            "delta_requests": sum(r.get("requests", 0)
+                                  for r in rep["layers"]),
+            "delta_seconds": round(delta_seconds, 3),
+            "full_pull_seconds": round(full_seconds, 3),
+            "delta_layers": rep["delta_layers"],
+            "fallback_layers": rep["fallback_layers"],
+            "digest_identity": identical,
+        }
+    finally:
+        # Shutdown on EVERY path (a failed build/pull assertion must
+        # not leak the accept thread over a socket inside the rmtree'd
+        # tmp dir), and close the listening fd too.
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if env_before is None:
+            os.environ.pop("MAKISU_TPU_SERVE", None)
+        else:
+            os.environ["MAKISU_TPU_SERVE"] = env_before
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _cache_explain_round() -> dict:
     """Cache-attribution micro-round: build a small context cold, warm,
     then once more with one edited file — through the real CLI with
@@ -1497,6 +1621,14 @@ def main() -> int:
         record["transfer"] = _transfer_micro()
     except Exception as e:  # noqa: BLE001 - informational section
         record["transfer"] = {"error": str(e)[:200]}
+    # Distribution-plane micro-section: delta-vs-full pull economics
+    # (bytes over the wire + wall time on a 1-edit image) with digest
+    # identity asserted — the serve plane's round-over-round number.
+    try:
+        if os.environ.get("MAKISU_BENCH_SERVE", "1") == "1":
+            record["serve"] = _serve_micro()
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["serve"] = {"error": str(e)[:200]}
     # Cache-attribution micro-round: the ledger summary (dedup ratio,
     # bytes refetched, flipped nodes on a 1-file edit) rides in the
     # record, and the full ledgers/explain text land as artifacts in

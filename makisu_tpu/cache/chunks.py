@@ -128,6 +128,56 @@ def _skip(stream, nbytes: int) -> None:
         nbytes -= len(step)
 
 
+def plan_pack_runs(rows, missing, gap=None, whole_fraction=None,
+                   pack_sizes=None):
+    """Group the missing chunks' pack spans into coalesced fetch runs.
+
+    The ONE definition of ranged-fetch economics, shared by the
+    registry pack path (``_fetch_from_packs``) and the serve/peer
+    plane (``serve/client.py``) — a tuning change here moves every
+    wire at once, none can drift.
+
+    ``rows`` are ``(fp, length, pack_hex, pack_off)`` rows (recipe
+    rows or pack member tables); returns ``(run_jobs, whole_jobs)``
+    where ``run_jobs`` is ``[(pack_hex, [run, ...])]`` with each run a
+    list of ``(pack_off, length, fp)`` spans sorted + coalesced (span
+    gap ≤ ``gap``), and ``whole_jobs`` names packs worth fetching
+    whole (needed fraction > ``whole_fraction`` of the pack's known
+    extent; pass ``whole_fraction=-1`` to force every pack whole — the
+    Range-less-transport degradation). Pure function — the
+    coalescing-correctness tests drive it directly."""
+    if gap is None:
+        gap = ChunkStore.PACK_RUN_GAP
+    if whole_fraction is None:
+        whole_fraction = ChunkStore.PACK_WHOLE_FETCH_FRACTION
+    by_pack: dict[str, dict[str, tuple[int, int]]] = {}
+    extents: dict[str, int] = dict(pack_sizes or {})
+    for fp, length, pack_hex, pack_off in rows:
+        extents[pack_hex] = max(extents.get(pack_hex, 0),
+                                int(pack_off) + int(length))
+        if fp in missing:
+            by_pack.setdefault(pack_hex, {}).setdefault(
+                fp, (int(pack_off), int(length)))
+    run_jobs: list[tuple[str, list]] = []
+    whole_jobs: list[str] = []
+    for pack_hex, wanted in sorted(by_pack.items()):
+        spans = sorted((off, length, fp)
+                       for fp, (off, length) in wanted.items())
+        needed = sum(length for _, length, _ in spans)
+        if needed > extents[pack_hex] * whole_fraction:
+            whole_jobs.append(pack_hex)
+            continue
+        runs: list[list] = []
+        for span in spans:
+            if (runs and span[0] - (runs[-1][-1][0] + runs[-1][-1][1])
+                    <= gap):
+                runs[-1].append(span)
+            else:
+                runs.append([span])
+        run_jobs.append((pack_hex, runs))
+    return run_jobs, whole_jobs
+
+
 class ChunkStore:
     """CAS of uncompressed-stream chunks, keyed by hex sha256.
 
@@ -545,8 +595,11 @@ class ChunkStore:
         # no-op.
         from makisu_tpu.fleet import peers as fleet_peers
         if fleet_peers.available():
-            from_peers = fleet_peers.fetch_chunks(self.put, missing,
-                                                  lengths)
+            # ledger_key IS the layer hex: it keys the peer's recipe,
+            # so the exchange rides coalesced ranged pack reads with
+            # the per-chunk GET kept as the old-worker fallback.
+            from_peers = fleet_peers.fetch_chunks(
+                self.put, missing, lengths, layer_hex=ledger_key)
             if from_peers:
                 events.emit("chunk_fetch", route="peer",
                             fetched=len(from_peers),
@@ -623,13 +676,16 @@ class ChunkStore:
                 off += length
             pack_sizes[pack_hex] = off
             pack_member_counts[pack_hex] = len(members)
-        by_pack: dict[str, list[str]] = {}
-        for hex_digest in missing:
-            if hex_digest in locate:
-                by_pack.setdefault(locate[hex_digest][0],
-                                   []).append(hex_digest)
+        rows = [(h, locate[h][2], locate[h][0], locate[h][1])
+                for h in dict.fromkeys(missing) if h in locate]
         got: set[str] = set()
+        # Per-pack sorted missing spans, for carving full-pack bodies
+        # and the degradation log.
         pack_spans: dict[str, list] = {}
+        for h, length, pack_hex, off in rows:
+            pack_spans.setdefault(pack_hex, []).append((off, length, h))
+        for spans in pack_spans.values():
+            spans.sort()
 
         def carve(pack_hex: str, data: bytes, base: int,
                   members) -> None:
@@ -648,31 +704,19 @@ class ChunkStore:
                                 pack_hex, hex_digest, e)
 
         # Plan: ranged runs for sparsely-needed packs, whole fetches
-        # for mostly-needed ones. Runs then execute on a pool — after a
-        # 1% edit of a 100k-file context there are ~a thousand novel
-        # regions, and round-trip LATENCY, not bytes, dominates them
-        # (measured: 2/3 of a warm pull was sequential ranged GETs).
-        run_jobs: list[tuple[str, list]] = []
-        whole_jobs: list[str] = []
-        for pack_hex, wanted in by_pack.items():
-            spans = sorted((locate[h][1], locate[h][2], h)
-                           for h in wanted)
-            pack_spans[pack_hex] = spans
-            needed = sum(length for _, length, _ in spans)
-            if (self.registry is None
-                    or needed > pack_sizes[pack_hex]
-                    * self.PACK_WHOLE_FETCH_FRACTION):
-                whole_jobs.append(pack_hex)
-                continue
-            runs: list[list] = []
-            for span in spans:
-                if (runs and span[0] - (runs[-1][-1][0]
-                                        + runs[-1][-1][1])
-                        <= self.PACK_RUN_GAP):
-                    runs[-1].append(span)
-                else:
-                    runs.append([span])
-            run_jobs.append((pack_hex, runs))
+        # for mostly-needed ones (shared planner — the serve/peer
+        # plane rides the same math). Runs then execute on a pool —
+        # after a 1% edit of a 100k-file context there are ~a thousand
+        # novel regions, and round-trip LATENCY, not bytes, dominates
+        # them (measured: 2/3 of a warm pull was sequential ranged
+        # GETs). A registry without pull_blob_range support can't do
+        # ranged runs at all: force every pack whole.
+        run_jobs, whole_jobs = plan_pack_runs(
+            rows, {r[0] for r in rows},
+            gap=self.PACK_RUN_GAP,
+            whole_fraction=(-1.0 if self.registry is None
+                            else self.PACK_WHOLE_FETCH_FRACTION),
+            pack_sizes=pack_sizes)
 
         requests_issued: list[int] = []  # list.append is GIL-atomic
         if run_jobs:
@@ -722,7 +766,7 @@ class ChunkStore:
         for pack_hex in whole_jobs:
             if not self._fetch_remote(pack_hex):
                 log.debug("pack %s unavailable; degrading %d chunks",
-                          pack_hex, len(by_pack[pack_hex]))
+                          pack_hex, len(pack_spans[pack_hex]))
                 continue
             n_requests += 1
             single = pack_member_counts[pack_hex] == 1
@@ -749,7 +793,7 @@ class ChunkStore:
                         requested=len(missing), requests=n_requests)
             log.info("fetched %d/%d missing chunks from %d pack(s) in "
                      "%d request(s)", len(got), len(missing),
-                     len(by_pack), n_requests)
+                     len(pack_spans), n_requests)
         unmapped = [h for h in missing
                     if h not in got and h not in locate]
         mapped_failed = any(h in locate and h not in got
@@ -1020,6 +1064,7 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                 _record_index(layer_hex, cache_id, triples, added)
                 log.info("indexed %d new chunks for %s", len(added),
                          cache_id)
+                _spawn_recipe_publish(pair, triples, commit, cache_id)
             except FileNotFoundError:
                 return
             finally:
@@ -1231,6 +1276,46 @@ def attach_chunk_dedup(manager, chunk_root: str) -> ChunkStore:
                          "falling back to blob materialization",
                          hex_digest)
         return inner_open_tar(pair)
+
+    def _publish_serve_recipe(pair, triples, commit) -> None:
+        """Distribution-plane publish hook: when this process serves
+        (worker / `makisu-tpu serve` / MAKISU_TPU_SERVE=1), every
+        indexed layer also gets a signed recipe + pack member tables
+        in ``<storage>/serve/`` — the metadata delta pulls and
+        pack-granular peer exchange consume. Never fails the build;
+        an unpublished layer just stays blob-route-only."""
+        from makisu_tpu.serve import server as serve_server
+        if not serve_server.publish_enabled():
+            return
+        try:
+            serve_store = serve_server.register_store(
+                manager.store.root)
+            serve_store.publish(pair, triples,
+                                commit.gzip_backend_id, chunk_store)
+        except Exception as e:  # noqa: BLE001 - publish is advisory
+            log.warning("serve recipe publish failed for %s: %s",
+                        pair.gzip_descriptor.digest.hex(), e)
+
+    def _spawn_recipe_publish(pair, triples, commit, cache_id) -> None:
+        """Recipe publish phase 2 re-reads and re-hashes every novel
+        chunk's bytes out of the CAS — gigabytes on a large cold layer
+        — so it rides a background thread exactly like the registry
+        chunk push, joined by ``wait_for_push`` (build exit still
+        implies published; a client asking earlier just takes the blob
+        route)."""
+        from makisu_tpu.serve import server as serve_server
+        if not serve_server.publish_enabled():
+            return
+        import contextvars
+        import threading
+        t = threading.Thread(
+            target=contextvars.copy_context().run,
+            args=(lambda: _publish_serve_recipe(pair, triples,
+                                                commit),),
+            daemon=True, name=f"recipepub-{cache_id}")
+        t.start()
+        with manager._lock:
+            manager._pushes.append(t)
 
     manager.push_cache = push_cache
     manager.pull_cache = pull_cache

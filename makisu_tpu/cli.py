@@ -197,6 +197,19 @@ def make_parser() -> argparse.ArgumentParser:
                            "layout (directory, or .tar oci-archive)")
     pull.add_argument("--storage", default="")
     pull.add_argument("--registry-config", default="")
+    pull.add_argument("--delta", default="", metavar="SOCKET",
+                      help="delta pull: layer bytes come from this "
+                           "serve endpoint (a `makisu-tpu serve` or "
+                           "worker unix socket) as coalesced ranged "
+                           "pack fetches of only the chunks missing "
+                           "from the local chunk CAS; manifest/config/"
+                           "identity still come from the registry, "
+                           "and any layer without a published recipe "
+                           "falls back to the registry blob route")
+    pull.add_argument("--report-out", default="", metavar="FILE",
+                      help="write the delta-pull economics report "
+                           "(bytes fetched vs full image, per-layer "
+                           "routes) as JSON")
 
     push = sub.add_parser("push", help="push an image tar to registries")
     push.add_argument("tar_path")
@@ -224,6 +237,18 @@ def make_parser() -> argparse.ArgumentParser:
                              "latency histograms, GET /builds). "
                              "0 = unlimited (default; env "
                              "MAKISU_TPU_MAX_CONCURRENT_BUILDS)")
+
+    serve = sub.add_parser(
+        "serve", help="run a chunk-native distribution endpoint over "
+                      "a storage directory (signed layer recipes + "
+                      "ranged pack serving for delta pulls)")
+    serve.add_argument("--socket",
+                       default="/tmp/makisu-tpu-serve.sock",
+                       help="unix socket to listen on")
+    serve.add_argument("--storage", default="",
+                       help="storage directory to serve (a builder's "
+                            "--storage; recipes/packs under serve/, "
+                            "chunk bytes under chunks/)")
 
     fleet = sub.add_parser(
         "fleet", help="run the build-farm front door: route builds "
@@ -784,7 +809,44 @@ def cmd_pull(args) -> int:
                   if args.registry_config else None)
     name = ImageName.parse_for_pull(args.image)
     with ImageStore(_storage_dir(args.storage)) as store:
-        manifest = new_client(store, name, config_map=config_map).pull(name)
+        if args.delta:
+            from makisu_tpu.serve import pull_image_delta
+            client = new_client(store, name, config_map=config_map)
+            manifest, report = pull_image_delta(client, store, name,
+                                                args.delta)
+        else:
+            client = new_client(store, name, config_map=config_map)
+            # Snapshot which layers are already local BEFORE the pull:
+            # pull_layer no-ops on present blobs, and the report must
+            # say so (route "local", zero wire bytes) the same way the
+            # delta report does for the same warm store. The snapshot
+            # costs an extra manifest GET, so it only runs when a
+            # report was actually asked for.
+            local: set[str] = set()
+            if args.report_out:
+                pre = client.pull_manifest(name.tag)
+                local = {d.digest.hex() for d in pre.layers
+                         if store.layers.exists(d.digest.hex())}
+            manifest = client.pull(name)
+            if args.report_out:
+                # Shared builder with the delta report, so a consumer
+                # pointed at either file reads one shape. Repeated
+                # digests dedup exactly like pull_image_delta's walk,
+                # so the two reports agree on layer count and
+                # denominator for the same image.
+                from makisu_tpu.serve.client import build_pull_report
+                uniq: dict[str, int] = {}
+                for d in manifest.layers:
+                    uniq.setdefault(d.digest.hex(), d.size)
+                report = build_pull_report(name, "", [
+                    {"layer": hx,
+                     "route": "local" if hx in local else "blob",
+                     "size": size,
+                     "bytes_fetched": 0 if hx in local else size}
+                    for hx, size in uniq.items()])
+        if args.report_out:
+            from makisu_tpu.utils import fileio
+            fileio.write_json_atomic(args.report_out, report)
         log.info("pulled %s (%d layers)", name, len(manifest.layers))
         if args.oci_dest:
             from makisu_tpu.docker.oci import write_oci_layout
@@ -1112,6 +1174,26 @@ def cmd_worker(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the standalone distribution endpoint: read-only recipes +
+    ranged pack serving over one storage directory a builder (or
+    worker) populates. The CDN-edge shape of the serve plane — workers
+    embed the same handlers on their own sockets."""
+    from makisu_tpu.serve import ServeServer
+    server = ServeServer(args.socket, _storage_dir(args.storage))
+    stats = server.store.stats()
+    log.info("serve endpoint on %s over %s (%d recipe(s), %d pack(s), "
+             "%d pack bytes)", args.socket, server.storage_dir,
+             stats["recipes"], stats["packs"], stats["pack_bytes"])
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
 def cmd_fleet(args) -> int:
     """Run the build-farm front door: a scheduler that fronts N
     workers, routing each build to the worker holding its resident
@@ -1227,6 +1309,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     handlers = {"build": cmd_build, "pull": cmd_pull, "push": cmd_push,
                 "diff": cmd_diff, "worker": cmd_worker,
+                "serve": cmd_serve,
                 "fleet": cmd_fleet, "report": cmd_report,
                 "doctor": cmd_doctor, "explain": cmd_explain,
                 "check": cmd_check, "top": cmd_top,
@@ -1335,7 +1418,8 @@ def main(argv: list[str] | None = None) -> int:
     watchdog = None
     stall_timeout = (args.stall_timeout or
                      flightrecorder.stall_timeout_from_env())
-    if stall_timeout > 0 and args.command not in ("worker", "fleet"):
+    if stall_timeout > 0 and args.command not in ("worker", "fleet",
+                                                  "serve"):
         watchdog = flightrecorder.StallWatchdog(
             stall_timeout, recorder,
             flightrecorder.forced_bundle_path(

@@ -28,6 +28,14 @@ MEDIA_TYPE_LAYER = "application/vnd.docker.image.rootfs.diff.tar.gzip"
 MEDIA_TYPE_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
 MEDIA_TYPE_OCI_CONFIG = "application/vnd.oci.image.config.v1+json"
 MEDIA_TYPE_OCI_LAYER = "application/vnd.oci.image.layer.v1.tar+gzip"
+# zstd layers (OCI 1.1; containerd/buildkit publish these): accepted on
+# pull when libzstd can decode them (utils/zstdio), stored and pushed
+# verbatim under their own digest — only the apply-time inflate differs
+# (tario.gzip_reader sniffs the frame magic). Layers this builder
+# WRITES stay deterministic gzip: cache identity and chunk
+# reconstitution depend on it.
+MEDIA_TYPE_OCI_LAYER_ZSTD = "application/vnd.oci.image.layer.v1.tar+zstd"
+MEDIA_TYPE_LAYER_ZSTD = "application/vnd.docker.image.rootfs.diff.tar.zstd"
 
 # Multi-arch fan-out documents: resolved to a platform manifest on pull
 # (capability the reference LACKS — it errors on these; docker selects

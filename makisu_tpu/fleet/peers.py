@@ -9,12 +9,17 @@ socket — BEFORE the registry/KV blob plane is paid. A sibling worker
 that built the same (or any chunk-sharing) context holds the bytes one
 unix-socket round trip away; the registry is a WAN away.
 
-Scope is deliberately minimal (the ISSUE's "peer exchange", not a
-content store): per-chunk GETs, digest-verified on arrival, charged
-against the transfer engine's memory budget so peer traffic and
-registry traffic share one bound. Pack-granular peer exchange and
-unified blob/chunk/pack stores stay their own PR (ROADMAP item 1's
-"unlock refactor").
+The exchange is **pack-granular** (ROADMAP item 1's named follow-up):
+a fetch first asks each peer for the layer's signed recipe
+(``GET /recipes/<layer_hex>`` — the distribution plane's metadata,
+makisu_tpu/serve/) and pulls the missing chunks as coalesced ranged
+pack reads (``GET /packs/<hex>`` with Range), so after a 1% edit the
+peer wire carries ~the novel-region count in round trips instead of
+one request per ~8KiB chunk. The per-chunk ``GET /chunks/<fp>`` route
+is kept strictly as the fallback — old workers without the serve
+endpoints, and chunks no published recipe covers. Both routes are
+digest-verified on arrival and charged against the transfer engine's
+memory budget so peer traffic and registry traffic share one bound.
 
 In-process fleets (loadgen ``--fleet``, tests) share this module's
 globals across their workers; that is correct — they also share one
@@ -169,19 +174,76 @@ def fetch_chunk(hex_digest: str) -> bytes | None:
     return None
 
 
+def fetch_via_packs(put, missing: list[str],
+                    layer_hex: str) -> set[str]:
+    """Pack-granular exchange: ask each live peer for the layer's
+    signed recipe; a peer that answers serves the missing chunks as
+    coalesced ranged pack reads through the shared planning/fetch core
+    (serve/client.py — per-run budget reservations, digest-verified
+    carving). Peers that 404 (old workers, or the layer just isn't
+    published there) cost one round trip and fall through; remaining
+    chunks go to the per-chunk fallback. Returns the digests
+    obtained."""
+    from makisu_tpu.serve.client import ServeClient, fetch_missing
+    want = set(missing)
+    got: set[str] = set()
+    rotation = int(layer_hex[:8], 16) if len(layer_hex) >= 8 else 0
+    for peer in _candidates(rotation):
+        if not want:
+            break
+        client = ServeClient(peer, timeout=PEER_TIMEOUT,
+                             connect_timeout=PEER_TIMEOUT)
+        doc = client.recipe(layer_hex)
+        if doc is None:
+            if client.transport_failures:
+                # Dead/wedged peer (not a 404): back it off like the
+                # per-chunk route does, instead of re-paying the
+                # timeout on every later layer.
+                _mark_dead(peer)
+            continue
+        covered = {row[0] for row in doc["chunks"]} & want
+        if not covered:
+            continue
+        from_peer, stats = fetch_missing(client.pack_range,
+                                         doc["chunks"], covered, put,
+                                         pack_sizes=doc.get("packs"))
+        if client.transport_failures:
+            _mark_dead(peer)
+        if stats["requests"]:
+            metrics.counter_add(metrics.SERVE_PEER_PACK_REQUESTS,
+                                stats["requests"])
+            metrics.counter_add(metrics.SERVE_PEER_PACK_BYTES,
+                                stats["bytes_fetched"])
+        if from_peer:
+            log.info("fetched %d/%d missing chunks from peer %s as "
+                     "%d ranged pack read(s)", len(from_peer),
+                     len(want), peer, stats["requests"])
+        got |= from_peer
+        want -= from_peer
+    return got
+
+
 def fetch_chunks(put, missing: list[str],
-                 lengths: dict[str, int]) -> set[str]:
-    """Fetch ``missing`` chunks from peers in parallel on the transfer
+                 lengths: dict[str, int],
+                 layer_hex: str | None = None) -> set[str]:
+    """Fetch ``missing`` chunks from peers: pack-granular first when
+    the caller can name the layer (``layer_hex`` — recipes are keyed
+    by it), then the per-chunk fallback in parallel on the transfer
     engine (blob-granular leaves, like the registry chunk fetches they
     stand in front of), each reservation charged to the global memory
     budget. ``put(hex, bytes)`` stores a verified chunk (ChunkStore.put
     re-verifies; cheap). Returns the digests obtained."""
     if not missing or not available():
         return set()
-    from makisu_tpu.registry import transfer
-    engine = transfer.engine()
+    requested = len(missing)
     got: set[str] = set()
     got_bytes = [0]
+    if layer_hex:
+        got = fetch_via_packs(put, missing, layer_hex)
+        got_bytes[0] = sum(lengths.get(h, 0) for h in got)
+        missing = [h for h in missing if h not in got]
+    from makisu_tpu.registry import transfer
+    engine = transfer.engine()
     mu = threading.Lock()
 
     def fetch_one(hex_digest: str) -> None:
@@ -199,10 +261,11 @@ def fetch_chunks(put, missing: list[str],
             got.add(hex_digest)
             got_bytes[0] += len(data)
 
-    engine.map(fetch_one, missing)
+    if missing:
+        engine.map(fetch_one, missing)
     if got:
         metrics.counter_add(PEER_CHUNK_HITS, len(got))
         metrics.counter_add(PEER_CHUNK_BYTES, got_bytes[0])
-    if len(got) < len(missing):
-        metrics.counter_add(PEER_CHUNK_MISSES, len(missing) - len(got))
+    if requested > len(got):
+        metrics.counter_add(PEER_CHUNK_MISSES, requested - len(got))
     return got

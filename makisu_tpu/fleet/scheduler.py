@@ -156,6 +156,10 @@ class WorkerState:
         self.max_concurrent = 0
         self.sessions: set[str] = set()
         self.session_hits = 0
+        # Distribution-plane digest from /healthz: what the worker can
+        # serve (recipes/packs its builds published) and how much it
+        # has served — the peer plane's capacity signal per worker.
+        self.serve: dict = {}
         self.builds_succeeded = 0
         self.builds_failed = 0
         # Local estimate: builds this front door currently has open
@@ -187,6 +191,7 @@ class WorkerState:
             "max_concurrent_builds": self.max_concurrent,
             "sessions": sorted(self.sessions),
             "session_hits": self.session_hits,
+            "serve": dict(self.serve),
             "builds_succeeded": self.builds_succeeded,
             "builds_failed": self.builds_failed,
             "routed_total": self.routed_total,
@@ -322,6 +327,7 @@ class FleetScheduler:
                     row.get("context", "")
                     for row in sessions.get("sessions", [])}
                 state.session_hits = int(sessions.get("hits", 0))
+                state.serve = dict(health.get("serve") or {})
                 if not was_alive:
                     self._peer_version += 1  # membership changed
                 else:

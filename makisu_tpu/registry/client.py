@@ -371,29 +371,56 @@ class RegistryClient:
         """Rewrite OCI media types to the docker schema2 equivalents —
         byte-identical formats for gzip layers — so descriptors that
         propagate into built images and pushes stay self-consistent.
-        Non-gzip layers (zstd, uncompressed) are rejected up front
-        rather than failing deep in the build."""
-        from makisu_tpu.docker.image import Descriptor
+        zstd layers are accepted when libzstd can decode them (the blob
+        is stored and pushed VERBATIM under its own digest and media
+        type; only the apply-time inflate differs — tario.gzip_reader
+        sniffs the frame magic). Anything else (uncompressed tar, or
+        zstd on a host without libzstd) is rejected up front rather
+        than failing deep in the build."""
+        from makisu_tpu.docker.image import (
+            MEDIA_TYPE_LAYER_ZSTD,
+            MEDIA_TYPE_OCI_LAYER_ZSTD,
+            Descriptor,
+        )
+        from makisu_tpu.utils import zstdio
+
+        zstd_types = (MEDIA_TYPE_OCI_LAYER_ZSTD, MEDIA_TYPE_LAYER_ZSTD)
+
+        def check_zstd(desc: Descriptor) -> Descriptor:
+            if not zstdio.available():
+                raise ValueError(
+                    f"layer {desc.digest} is zstd-compressed "
+                    f"({desc.media_type!r}) but libzstd is not "
+                    f"available in this process; install libzstd to "
+                    f"pull zstd-published images")
+            return desc  # kept verbatim: digest/size/media type all true
+
         if manifest.media_type == MEDIA_TYPE_MANIFEST:
             unsupported = [l.media_type for l in manifest.layers
-                           if l.media_type != MEDIA_TYPE_LAYER]
+                           if l.media_type != MEDIA_TYPE_LAYER
+                           and l.media_type not in zstd_types]
             if unsupported:
                 raise ValueError(
                     f"unsupported layer media types: {unsupported}")
+            for layer in manifest.layers:
+                if layer.media_type in zstd_types:
+                    check_zstd(layer)
             return manifest
-        def fix(desc: Descriptor, kind_ok: str, to: str) -> Descriptor:
-            if desc.media_type != kind_ok:
+
+        def fix(desc: Descriptor) -> Descriptor:
+            if desc.media_type in zstd_types:
+                return check_zstd(desc)
+            if desc.media_type != MEDIA_TYPE_OCI_LAYER:
                 raise ValueError(
                     f"unsupported layer media type {desc.media_type!r} "
-                    "(only gzip tar layers are supported)")
-            return Descriptor(to, desc.size, desc.digest)
+                    "(only gzip and zstd tar layers are supported)")
+            return Descriptor(MEDIA_TYPE_LAYER, desc.size, desc.digest)
         return DistributionManifest(
             schema_version=2,
             media_type=MEDIA_TYPE_MANIFEST,
             config=Descriptor(MEDIA_TYPE_CONFIG, manifest.config.size,
                               manifest.config.digest),
-            layers=[fix(l, MEDIA_TYPE_OCI_LAYER, MEDIA_TYPE_LAYER)
-                    for l in manifest.layers])
+            layers=[fix(l) for l in manifest.layers])
 
     def pull_layer(self, digest: Digest, size: int = 0) -> str:
         """Download one blob into the CAS store (no-op if present).

@@ -1,0 +1,26 @@
+"""Chunk-native distribution plane: serve images, not just build them.
+
+The fourth plane (build, cache, fleet, **distribution**): built layers
+publish signed recipes (ordered chunk→pack tables), a serve endpoint
+answers ranged pack fetches synthesized from the chunk CAS under the
+transfer engine's memory budget, and chunk-aware clients delta-pull —
+fetching only the chunks they don't already hold — asserting
+byte-identical registry digests before install. The fleet peer plane
+rides the same endpoint (``fleet/peers.py``). See docs/SERVE.md.
+"""
+
+from makisu_tpu.serve.client import (  # noqa: F401
+    ServeClient,
+    delta_pull_layer,
+    pull_image_delta,
+)
+from makisu_tpu.serve.recipe import RECIPE_SCHEMA, RecipeStore  # noqa: F401
+from makisu_tpu.serve.server import (  # noqa: F401
+    ServeServer,
+    enable_publishing,
+    publish_enabled,
+    register_store,
+    serve_stats,
+    store_for,
+    stores,
+)

@@ -227,7 +227,22 @@ def gzip_writer(fileobj: BinaryIO, level: int | None = None,
     return gz
 
 
-def gzip_reader(fileobj: BinaryIO) -> gzip.GzipFile:
+def gzip_reader(fileobj: BinaryIO):
+    """Layer-blob reader: gzip by default, transparently zstd when the
+    blob's frame magic says so (zstd-published base images reach every
+    apply/extract/diff site through this one function). Unseekable
+    inputs keep the legacy gzip-only path — every layer-blob call site
+    hands in a real file, and a wrong guess on an exotic stream must
+    not break it."""
+    try:
+        pos = fileobj.tell()
+        head = fileobj.read(4)
+        fileobj.seek(pos)
+    except (OSError, AttributeError):
+        return gzip.GzipFile(fileobj=fileobj, mode="rb")
+    from makisu_tpu.utils import zstdio
+    if zstdio.is_zstd(head):
+        return zstdio.ZstdReader(fileobj)
     return gzip.GzipFile(fileobj=fileobj, mode="rb")
 
 

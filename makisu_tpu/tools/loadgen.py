@@ -462,7 +462,9 @@ def render_report(report: dict) -> str:
             f"{'identical' if fleet['digest_identity'] else 'DIVERGED'}")
         lines.append(
             f"    peer chunks {fleet['peer_chunk_hits']} "
-            f"({fleet['peer_chunk_bytes']} B) served worker-to-worker")
+            f"({fleet['peer_chunk_bytes']} B) served worker-to-worker "
+            f"via {fleet.get('peer_pack_requests', 0)} ranged pack "
+            f"read(s) ({fleet.get('peer_pack_bytes', 0)} B)")
         lines.append(
             f"    p99 {fleet['p99_seconds']:.3f}s vs single-worker "
             f"{fleet['baseline_p99_seconds']:.3f}s "
@@ -865,6 +867,18 @@ def _build_fleet_report(args, results, baseline_results, disruption,
         "makisu_fleet_peer_chunk_bytes_total"))
     chunk_serves = int(registry.counter_total(
         "makisu_fleet_chunk_serves_total", result="hit"))
+    # Pack-granular exchange telemetry (the distribution plane the
+    # peer fetches now ride): the requests counter is the wire proof
+    # that missing chunks moved as coalesced ranged pack reads, not
+    # one GET per chunk.
+    peer_pack_requests = int(registry.counter_total(
+        metrics.SERVE_PEER_PACK_REQUESTS))
+    peer_pack_bytes = int(registry.counter_total(
+        metrics.SERVE_PEER_PACK_BYTES))
+    pack_serves = int(registry.counter_total(
+        metrics.SERVE_PACK_REQUESTS, kind="range")) + int(
+        registry.counter_total(metrics.SERVE_PACK_REQUESTS,
+                               kind="full"))
     fleet_p99 = metrics.percentile_stats(latencies).get("p99", 0.0)
     base_p99 = metrics.percentile_stats(base_latencies).get("p99", 0.0)
     failovers = [r for r in ok_rows if r["verdict"] == "failover"
@@ -931,6 +945,9 @@ def _build_fleet_report(args, results, baseline_results, disruption,
             "peer_chunk_hits": peer_hits,
             "peer_chunk_bytes": peer_bytes,
             "peer_chunk_serves": chunk_serves,
+            "peer_pack_requests": peer_pack_requests,
+            "peer_pack_bytes": peer_pack_bytes,
+            "pack_serves": pack_serves,
             "baseline": {
                 "wall_seconds": round(baseline_wall, 3),
                 "builds": len(baseline_results),

@@ -81,6 +81,21 @@ FLEET_PEER_MAP_VERSION = "makisu_fleet_peer_map_version"
 FLEET_CHUNK_SERVES = "makisu_fleet_chunk_serves_total"
 FLEET_CHUNK_SERVE_BYTES = "makisu_fleet_chunk_serve_bytes_total"
 
+# Chunk-native distribution plane (makisu_tpu/serve/): one name set
+# shared by the recipe store, the serve/worker endpoints, the delta-pull
+# client, the peer pack exchange, loadgen's fleet report, and the docs'
+# metric table. Recipe/pack request counters label result/kind; the
+# delta byte counters split a pull's economics into wire-fetched vs
+# locally-reused bytes.
+SERVE_RECIPES_PUBLISHED = "makisu_serve_recipes_published_total"
+SERVE_RECIPE_REQUESTS = "makisu_serve_recipe_requests_total"
+SERVE_PACK_REQUESTS = "makisu_serve_pack_requests_total"
+SERVE_PACK_BYTES = "makisu_serve_pack_bytes_total"
+SERVE_DELTA_PULLS = "makisu_serve_delta_pulls_total"
+SERVE_DELTA_BYTES = "makisu_serve_delta_bytes_total"
+SERVE_PEER_PACK_REQUESTS = "makisu_serve_peer_pack_requests_total"
+SERVE_PEER_PACK_BYTES = "makisu_serve_peer_pack_bytes_total"
+
 # Deploy-identity info gauge (cli.main): constant 1, identity in the
 # labels — the node_exporter "build_info" idiom.
 BUILD_INFO = "makisu_build_info"

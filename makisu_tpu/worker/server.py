@@ -303,8 +303,25 @@ class _Handler(BaseHTTPRequestHandler):
             # Peer chunk exchange, serving side: read-only chunk bytes
             # out of the local chunk CAS(es). Strictly local — a miss
             # is a prompt 404, never a proxied fetch (see
-            # cache/chunks.py open_served_chunk).
+            # cache/chunks.py open_served_chunk). Kept as the
+            # compatibility fallback; pack-granular peers prefer
+            # /recipes + /packs below.
             self._serve_chunk(self.path[len("/chunks/"):])
+        elif self.path.startswith("/recipes/"):
+            # Distribution plane, embedded: signed layer recipes for
+            # the layers THIS worker's builds published (same
+            # per-server honesty scoping as /chunks).
+            from makisu_tpu.serve import server as serve_server
+            serve_server.handle_recipe(
+                self, self.path[len("/recipes/"):],
+                roots=self.server.served_chunk_roots())
+        elif self.path.startswith("/packs/"):
+            # Ranged pack serving: spans synthesized from the chunk
+            # CAS, streamed under the transfer memory budget.
+            from makisu_tpu.serve import server as serve_server
+            serve_server.handle_pack(
+                self, self.path[len("/packs/"):],
+                roots=self.server.served_chunk_roots())
         elif self.path == "/peers":
             from makisu_tpu.fleet import peers as fleet_peers
             self._respond(200, json.dumps({
@@ -620,6 +637,12 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # nowhere else, and /sessions is a truthful affinity signal.
         from makisu_tpu.worker import session as session_mod
         self.session_mgr = session_mod.SessionManager()
+        # Distribution plane: a worker is a serving process, so its
+        # builds publish layer recipes at index time (MAKISU_TPU_SERVE=0
+        # still wins) — that is what makes this worker's /recipes +
+        # /packs answer for fleet peers and delta-pull clients.
+        from makisu_tpu.serve import server as serve_server
+        serve_server.enable_publishing()
         # Chunk CAS roots THIS server's builds have used: the /chunks
         # peer endpoint serves only these (the process-wide registry
         # would also hold in-process siblings' stores, and serving a
@@ -677,11 +700,35 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     def add_served_chunk_root(self, storage_dir: str) -> None:
         """Mark a storage's chunk CAS as servable by THIS worker's
         ``/chunks`` endpoint (run_build records every build's storage;
-        embedders/tests may add roots directly — pass the chunk CAS
-        dir itself or the storage dir containing ``chunks/``)."""
+        embedders/tests may add roots directly — pass the storage dir
+        containing ``chunks/``, or that ``chunks/`` dir itself). The
+        storage's serve store (recipes + pack tables, at
+        ``<storage>/serve``) registers alongside so /recipes and
+        /packs answer for it too — the ``chunks/``-suffixed shape
+        registers its PARENT storage, because registering the CAS dir
+        itself would mint a store looking for recipes under
+        ``<cas>/serve`` that the publisher never writes, silently
+        degrading this worker's peer exchange to per-chunk GETs. A
+        bare nonstandard CAS path has no recipe metadata to find and
+        serves per-chunk only."""
         root = os.path.realpath(storage_dir)
         chunk_root = os.path.realpath(os.path.join(storage_dir,
                                                    "chunks"))
+        from makisu_tpu.serve import server as serve_server
+        if os.path.basename(root) == "chunks":
+            # Ambiguous shape: a CAS dir handed directly (the common
+            # embedder/test idiom), or a STORAGE dir that merely
+            # happens to be named "chunks". The publisher writes serve
+            # metadata at <storage>/serve, so probe for it before
+            # assuming the parent — registering the wrong root would
+            # 404 every /recipes lookup and silently degrade this
+            # worker's peer exchange to per-chunk GETs.
+            if os.path.isdir(os.path.join(root, "serve")):
+                serve_server.register_store(storage_dir)
+            else:
+                serve_server.register_store(os.path.dirname(root))
+        else:
+            serve_server.register_store(storage_dir)
         with self._builds_mu:
             self._served_chunk_roots.update((root, chunk_root))
 
@@ -940,6 +987,16 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                     ("count", "resident_bytes", "hits",
                      "invalidations", "max_sessions",
                      "max_resident_bytes")}
+        # Distribution-plane vitals: what this worker can serve
+        # (recipes/packs published by its builds) — the capacity
+        # signal the fleet scheduler surfaces per worker. Scoped to
+        # THIS server's stores only; the process-global request/byte
+        # counters live on /metrics (in an in-process fleet they
+        # aggregate every sibling and would misattribute traffic
+        # here).
+        from makisu_tpu.serve import server as serve_server
+        serve = serve_server.serve_stats(
+            roots=self.served_chunk_roots())
         return {
             "status": "ok",
             "uptime_seconds": round(
@@ -952,6 +1009,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "cache": cache,
             "device": device,
             "sessions": sessions,
+            "serve": serve,
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on
             # active_builds > 0 && last_progress_seconds > window sees

@@ -354,8 +354,15 @@ def test_peer_chunk_fetch_hits_before_registry(tmp_path, fleet2):
     g = metrics.global_registry()
     before_hits = g.counter_total(
         "makisu_fleet_peer_chunk_hits_total")
-    before_serves = g.counter_total(
-        "makisu_fleet_chunk_serves_total", result="hit")
+    # The exchange now rides ranged pack fetches (the distribution
+    # plane) with per-chunk GETs as the fallback — the serving-side
+    # proof is the sum over both routes (tests/test_serve.py asserts
+    # the pack route specifically).
+    before_serves = (
+        g.counter_total("makisu_fleet_chunk_serves_total",
+                        result="hit")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="range")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full"))
     ctx = _make_ctx(tmp_path, "peer-ctx")
     argv = _build_argv(tmp_path, ctx, fleet2.kv_addr)
     assert fleet2.client.build(argv, tenant="t") == 0
@@ -374,8 +381,11 @@ def test_peer_chunk_fetch_hits_before_registry(tmp_path, fleet2):
     second = dict(fleet2.client.last_build)
     assert second["worker"] != holder
     hits = g.counter_total("makisu_fleet_peer_chunk_hits_total")
-    serves = g.counter_total("makisu_fleet_chunk_serves_total",
-                             result="hit")
+    serves = (
+        g.counter_total("makisu_fleet_chunk_serves_total",
+                        result="hit")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="range")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full"))
     assert hits > before_hits, "no chunk came from a peer"
     assert serves > before_serves, "no worker served a peer fetch"
     # Byte identity across the relocation.

@@ -343,8 +343,15 @@ def test_pull_image_through_index_end_to_end(store, fixture):
         assert store.layers.exists(desc.digest.hex())
 
 
-def test_pull_manifest_rejects_zstd_layers(store, fixture):
+def test_pull_manifest_zstd_layers_gated_on_libzstd(store, fixture,
+                                                    monkeypatch):
+    """zstd layers are accepted when libzstd can decode them (kept
+    verbatim under their own media type) and rejected up front with an
+    error naming libzstd when it can't (tests/test_zstdio.py covers
+    the decode side end to end)."""
     import json as json_mod
+
+    from makisu_tpu.utils import zstdio
     manifest, config_blob, blobs = make_test_image()
     raw = json_mod.loads(manifest.to_bytes())
     raw["mediaType"] = MEDIA_TYPE_OCI_MANIFEST
@@ -352,8 +359,13 @@ def test_pull_manifest_rejects_zstd_layers(store, fixture):
     for layer in raw["layers"]:
         layer["mediaType"] = "application/vnd.oci.image.layer.v1.tar+zstd"
     fixture.manifests["team/app:zstd"] = json_mod.dumps(raw).encode()
-    with pytest.raises(ValueError, match="layer media type"):
+    monkeypatch.setattr(zstdio, "available", lambda: False)
+    with pytest.raises(ValueError, match="libzstd"):
         client(store, fixture).pull_manifest("zstd")
+    monkeypatch.setattr(zstdio, "available", lambda: True)
+    pulled = client(store, fixture).pull_manifest("zstd")
+    assert pulled.layers[0].media_type == \
+        "application/vnd.oci.image.layer.v1.tar+zstd"
 
 
 def test_blob_redirect_chain_followed(store, fixture):
