@@ -1006,6 +1006,79 @@ def _transfer_micro() -> dict:
     }
 
 
+def _compress_micro(nbytes: int = 32 * 1024 * 1024) -> dict:
+    """Compression-plane micro-bench (ROADMAP item 4): GB/s per gzip
+    backend × compress worker count through the real writers —
+    ``zlib`` (continuous stream, inherently one lane) and ``pgzip``
+    (the block-parallel stage on the shared hash pool) — plus the
+    seekable-pack plane's zstd frame encode/decode throughput. The
+    payload is half pseudo-random, half repetitive: all-random would
+    flatten deflate into a memcpy race, all-zeros would flatten it
+    into CPU-free RLE, and real layer tars sit between. Pure CPU, a
+    few seconds. MAKISU_BENCH_COMPRESS=0 skips the section."""
+    import io
+
+    from makisu_tpu import tario
+    from makisu_tpu.utils import concurrency, zstdio
+
+    rng = np.random.default_rng(17)
+    half = nbytes // 2
+    payload = (rng.integers(0, 256, size=half, dtype=np.uint8).tobytes()
+               + (b"the quick brown makisu jumps over the lazy tpu\n"
+                  * (half // 47))[:nbytes - half])
+    out = {"payload_mb": round(len(payload) / 1e6, 1)}
+
+    class _Null:
+        def write(self, data):
+            return len(data)
+
+        def flush(self):
+            pass
+
+    def run(backend_id: str, workers: int) -> float:
+        token = concurrency.set_compress_workers(workers)
+        try:
+            best = 0.0
+            for _ in range(2):
+                sink = _Null()
+                t0 = time.perf_counter()
+                gz = tario.gzip_writer(sink, backend_id=backend_id)
+                for i in range(0, len(payload), 1 << 20):
+                    gz.write(payload[i:i + (1 << 20)])
+                gz.close()
+                dt = time.perf_counter() - t0
+                best = max(best, len(payload) / dt / 1e9)
+            return round(best, 3)
+        finally:
+            concurrency.reset_compress_workers(token)
+
+    lanes = concurrency.default_compress_workers()
+    out["workers"] = lanes
+    out["zlib_gbps_1"] = run("zlib-6", 1)
+    out["pgzip_gbps_1"] = run("pgzip-6-131072", 1)
+    if lanes > 1:
+        out["pgzip_gbps_n"] = run("pgzip-6-131072", lanes)
+        if out["pgzip_gbps_1"]:
+            out["pgzip_scale"] = round(
+                out["pgzip_gbps_n"] / out["pgzip_gbps_1"], 2)
+    if zstdio.available():
+        frame = 256 * 1024
+        frames = [payload[i:i + frame]
+                  for i in range(0, len(payload), frame)]
+        t0 = time.perf_counter()
+        zframes = [zstdio.compress(f) for f in frames]
+        out["zstd_encode_gbps"] = round(
+            len(payload) / (time.perf_counter() - t0) / 1e9, 3)
+        t0 = time.perf_counter()
+        for f, z in zip(frames, zframes):
+            zstdio.decompress(z, len(f))
+        out["zstd_decode_gbps"] = round(
+            len(payload) / (time.perf_counter() - t0) / 1e9, 3)
+        out["zstd_ratio"] = round(
+            sum(len(z) for z in zframes) / len(payload), 4)
+    return out
+
+
 def _serve_micro() -> dict:
     """Distribution-plane micro-bench: build v1 (recipes published),
     serve it, seed a client with a cold delta pull, 1-edit rebuild,
@@ -1106,6 +1179,12 @@ def _serve_micro() -> dict:
         return {
             "image_mb": round(len(v1) / (1 << 20), 1),
             "delta_bytes_fetched": rep["bytes_fetched"],
+            # What the raw pack wire would have moved for the same
+            # plan: delta_bytes_fetched <= this when the seekable-zstd
+            # frames carried the pull (the compressed-wire win,
+            # recorded NEXT TO the raw figure round over round).
+            "delta_raw_wire_bytes": rep.get("bytes_raw_wire",
+                                            rep["bytes_fetched"]),
             "full_image_bytes": rep["bytes_full_image"],
             "fetched_fraction": rep["fetched_fraction"],
             "delta_requests": sum(r.get("requests", 0)
@@ -1621,6 +1700,15 @@ def main() -> int:
         record["transfer"] = _transfer_micro()
     except Exception as e:  # noqa: BLE001 - informational section
         record["transfer"] = {"error": str(e)[:200]}
+    # Compression-plane micro-section: GB/s per backend × worker
+    # count through the real writers, plus zstd frame encode/decode —
+    # the ROADMAP item 4 "compression keeps up with the SIMD hashers"
+    # number. Pure CPU.
+    try:
+        if os.environ.get("MAKISU_BENCH_COMPRESS", "1") == "1":
+            record["compress_micro"] = _compress_micro()
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["compress_micro"] = {"error": str(e)[:200]}
     # Distribution-plane micro-section: delta-vs-full pull economics
     # (bytes over the wire + wall time on a 1-edit image) with digest
     # identity asserted — the serve plane's round-over-round number.

@@ -178,6 +178,44 @@ def plan_pack_runs(rows, missing, gap=None, whole_fraction=None,
     return run_jobs, whole_jobs
 
 
+def plan_frame_runs(frames, spans, gap=None):
+    """Map missing raw spans of ONE pack onto its seekable-zstd frame
+    index: which frames must be fetched, coalesced into ranged runs
+    over COMPRESSED bytes.
+
+    Lives beside :func:`plan_pack_runs` for the same reason that
+    function lives here — ranged-fetch economics have one definition,
+    and the serve client and the peer plane both ride it. ``frames``
+    are ``(raw_off, raw_len, z_off, z_len)`` rows (a recipe's
+    ``zpacks`` entry); ``spans`` are ``(raw_off, length, fp)`` missing
+    spans within the pack. Returns a list of runs, each a list of
+    frame rows whose compressed extents are adjacent or within ``gap``
+    bytes (the same over-fetch-vs-round-trip tradeoff as the raw
+    wire). Pure function — the planning tests drive it directly."""
+    import bisect
+    if gap is None:
+        gap = ChunkStore.PACK_RUN_GAP
+    rows = sorted([int(r[0]), int(r[1]), int(r[2]), int(r[3])]
+                  for r in frames)
+    starts = [r[0] for r in rows]
+    needed: set[int] = set()
+    for off, length, _fp in spans:
+        end = int(off) + int(length)
+        i = max(bisect.bisect_right(starts, int(off)) - 1, 0)
+        while i < len(rows) and rows[i][0] < end:
+            if rows[i][0] + rows[i][1] > int(off):
+                needed.add(i)
+            i += 1
+    runs: list[list] = []
+    for i in sorted(needed):
+        row = rows[i]
+        if runs and row[2] - (runs[-1][-1][2] + runs[-1][-1][3]) <= gap:
+            runs[-1].append(row)
+        else:
+            runs.append([row])
+    return runs
+
+
 class ChunkStore:
     """CAS of uncompressed-stream chunks, keyed by hex sha256.
 

@@ -57,6 +57,10 @@ class LayerSink:
     the reference's ConcurrentMultiWriter fan-out
     (lib/stream/multi_writer.go:25, lib/builder/step/common.go:47-56).
     Both hashlib and zlib release the GIL, so the overlap is real.
+    With the pgzip backend the writer behind the queue is itself the
+    block-parallel compress stage (tario.BlockGzipWriter): deflate
+    fans out across the shared hash pool at ``compress_workers()``
+    lanes, byte-identical at every count.
     """
 
     def __init__(self, out: BinaryIO, backend_id: str | None = None,
@@ -81,6 +85,14 @@ class LayerSink:
             import time as _time
             self._queue = queue.Queue(maxsize=8)
 
+            # A block-parallel writer (tario.BlockGzipWriter) reports
+            # its own compress busy seconds from its pool lanes; this
+            # feed thread's write() is then just buffering + batch
+            # submission, and charging it too would double-count the
+            # stage.
+            self_reporting = getattr(self._gz, "reports_compress_busy",
+                                     False)
+
             def run() -> None:
                 # Busy time accumulates locally and flushes once at
                 # stream end — per-write counter churn would become
@@ -99,7 +111,9 @@ class LayerSink:
                             return
                         busy += _time.monotonic() - t0
                 finally:
-                    metrics.stage_busy_add("compress", busy)
+                    if not self_reporting:
+                        metrics.stage_busy_add(metrics.COMPRESS_STAGE,
+                                               busy)
 
             # copy_context: the stage counter must land in the build's
             # registry, not just the process-global one (threads start
@@ -183,6 +197,11 @@ class LayerSink:
                 Digest.from_hex(self._tee.digest.hexdigest())))
         metrics.counter_add("makisu_bytes_hashed_total", self._nbytes,
                             backend="python", path="layer_sink")
+        backend = self.backend_id.split("-", 1)[0]
+        metrics.counter_add(metrics.COMPRESS_BYTES, self._nbytes,
+                            backend=backend, direction="in")
+        metrics.counter_add(metrics.COMPRESS_BYTES, self._tee.size,
+                            backend=backend, direction="out")
         return LayerCommit(pair, self._finish_chunks(),
                            gzip_backend_id=self.backend_id)
 
@@ -260,14 +279,19 @@ class NativeLayerSink:
     def __init__(self, out: BinaryIO, backend_id: str | None = None,
                  session=None) -> None:
         from makisu_tpu import native
+        from makisu_tpu.utils import concurrency
         self.backend_id = backend_id or tario.gzip_backend_id()
         self._nbytes = 0  # uncompressed bytes digested (telemetry)
         parts = self.backend_id.split("-")
         backend, level = parts[0], int(parts[1])
         block = int(parts[2]) if backend == "pgzip" else 0
         out.flush()  # nothing buffered may trail the native fd writes
+        # The compress-workers knob governs the C++ block pool too —
+        # same worker-count-is-throughput-only contract as the Python
+        # stage (block bytes are a pure function of level/block size).
         self._handle = native.LayerSinkHandle(
-            out.fileno(), backend, level, block or native.DEFAULT_BLOCK)
+            out.fileno(), backend, level, block or native.DEFAULT_BLOCK,
+            nthreads=concurrency.compress_workers())
         self._session = session
         if session is not None:
             self._handle.set_tap(session.update)
@@ -286,6 +310,11 @@ class NativeLayerSink:
         self._handle.close()
         metrics.counter_add("makisu_bytes_hashed_total", self._nbytes,
                             backend="native", path="layer_sink")
+        backend = self.backend_id.split("-", 1)[0]
+        metrics.counter_add(metrics.COMPRESS_BYTES, self._nbytes,
+                            backend=backend, direction="in")
+        metrics.counter_add(metrics.COMPRESS_BYTES, gz_size,
+                            backend=backend, direction="out")
         pair = DigestPair(
             tar_digest=Digest.from_hex(tar_hex),
             gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, gz_size,

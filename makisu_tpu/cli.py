@@ -60,6 +60,13 @@ def make_parser() -> argparse.ArgumentParser:
                              "threads (default min(8, cpu) on >=4-core "
                              "hosts, serial below; 1 = the serial "
                              "pipeline; env MAKISU_TPU_HASH_WORKERS)")
+    parser.add_argument("--compress-workers", type=int, default=0,
+                        metavar="N",
+                        help="block-parallel compress lanes for the "
+                             "pgzip backend (and the native sink's C++ "
+                             "block pool); bytes are identical at every "
+                             "count (default min(8, cpu); env "
+                             "MAKISU_TPU_COMPRESS_WORKERS)")
     parser.add_argument("--hash-linger-ms", type=float, default=-1.0,
                         metavar="MS",
                         help="shared hash-service batch linger in "
@@ -1300,6 +1307,10 @@ def main(argv: list[str] | None = None) -> int:
         # worker builds can carry different worker counts.
         hash_workers_token = concurrency.set_hash_workers(
             args.hash_workers)
+    compress_workers_token = None
+    if args.compress_workers > 0:
+        compress_workers_token = concurrency.set_compress_workers(
+            args.compress_workers)
     if args.hash_linger_ms >= 0:
         # Process-wide by design: the hash service batches ACROSS
         # builds, so there is one linger per process.
@@ -1358,6 +1369,7 @@ def main(argv: list[str] | None = None) -> int:
         platform=os.environ.get("JAX_PLATFORMS", "") or "default",
         mode=invocation_mode.get(),
         hash_workers=concurrency.hash_workers(),
+        compress_workers=concurrency.compress_workers(),
         hash_linger_ms=concurrency.hash_linger_ms(),
         native_isa=(_native.isa_label()
                     if args.command == "build"
@@ -1498,6 +1510,8 @@ def main(argv: list[str] | None = None) -> int:
         metrics.reset_build_registry(metrics_token)
         if hash_workers_token is not None:
             concurrency.reset_hash_workers(hash_workers_token)
+        if compress_workers_token is not None:
+            concurrency.reset_compress_workers(compress_workers_token)
         if jax_trace:
             import jax
             jax.profiler.stop_trace()

@@ -204,9 +204,15 @@ def fetch_via_packs(put, missing: list[str],
         covered = {row[0] for row in doc["chunks"]} & want
         if not covered:
             continue
+        # The peer wire rides the same seekable-zstd frames as the
+        # serve plane when the recipe advertises them (zpacks) — a
+        # relocated build's chunks cross worker sockets compressed;
+        # old peers without /zpacks 404 back onto the raw pack wire.
         from_peer, stats = fetch_missing(client.pack_range,
                                          doc["chunks"], covered, put,
-                                         pack_sizes=doc.get("packs"))
+                                         pack_sizes=doc.get("packs"),
+                                         zframes=doc.get("zpacks"),
+                                         fetch_zrange=client.zpack_range)
         if client.transport_failures:
             _mark_dead(peer)
         if stats["requests"]:

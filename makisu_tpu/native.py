@@ -41,6 +41,7 @@ _GEAR_PATH = os.path.join(_NATIVE_DIR, "libgear.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
+_pgz_blocks = False  # multi-block entry present in the loaded library
 _lsk_lib: ctypes.CDLL | None = None
 _lsk_failed = False
 
@@ -113,7 +114,7 @@ def _warn_if_stale(lib_path: str) -> None:
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
+    global _lib, _load_failed, _pgz_blocks
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
@@ -139,6 +140,22 @@ def _load() -> ctypes.CDLL | None:
             # AttributeError: stale .so missing a symbol — degrade, not
             # crash (ctypes raises it, not OSError, on dlsym misses).
             _load_failed = True
+            return _lib
+        try:
+            # Newer symbol, bound separately: with a prebuilt library
+            # from before the multi-block entry, the block-compress
+            # stage degrades to the stdlib-zlib codec (byte-identical
+            # output, just without the one-call batch amortization —
+            # see tario._deflate_blocks); PgzipWriter keeps its
+            # per-block pgz_block route either way.
+            lib.pgz_blocks.restype = ctypes.POINTER(ctypes.c_uint8)
+            lib.pgz_blocks.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_size_t, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_size_t)]
+            _pgz_blocks = True
+        except AttributeError:
+            _pgz_blocks = False
         return _lib
 
 
@@ -612,6 +629,33 @@ def _block_compress(data: bytes, level: int, last: bool) -> bytes:
                         ctypes.byref(out_n))
     if not buf:
         raise RuntimeError("pgz_block failed")
+    try:
+        return ctypes.string_at(buf, out_n.value)
+    finally:
+        lib.pgz_free(buf)
+
+
+def pgz_blocks_available() -> bool:
+    """Whether the loaded libpgzip.so has the multi-block entry (newer
+    symbol; a prebuilt pre-batch library still serves pgz_block)."""
+    return _load() is not None and _pgz_blocks
+
+
+def deflate_blocks(data: bytes, level: int, block_size: int,
+                   last: bool) -> bytes:
+    """Compress ``data`` as consecutive ``block_size`` raw-deflate
+    slices (sync-flush terminated; the final slice Z_FINISH when
+    ``last``) in ONE GIL-released native call — the block-compress
+    stage's per-lane unit (tario.BlockGzipWriter). Byte-identical to
+    compressing the slices one ``pgz_block`` call at a time."""
+    lib = _load()
+    if lib is None or not _pgz_blocks:
+        raise RuntimeError("libpgzip.so multi-block entry unavailable")
+    out_n = ctypes.c_size_t(0)
+    buf = lib.pgz_blocks(data, len(data), level, block_size,
+                         1 if last else 0, ctypes.byref(out_n))
+    if not buf:
+        raise RuntimeError("pgz_blocks failed")
     try:
         return ctypes.string_at(buf, out_n.value)
     finally:

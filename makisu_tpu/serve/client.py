@@ -38,6 +38,7 @@ from makisu_tpu.utils import metrics
 # ranged-fetch economics, not of the transport — one implementation
 # means a change there moves the serve/peer wire and the registry
 # wire together, never one without the other.
+from makisu_tpu.cache.chunks import plan_frame_runs
 from makisu_tpu.cache.chunks import plan_pack_runs as plan_runs
 
 # Connect/read timeouts for serve-endpoint requests: local-ish sockets;
@@ -152,7 +153,7 @@ class ServeClient:
     def pack_range(self, pack_hex: str, start: int, end: int,
                    limit: int | None = None
                    ) -> tuple[str, bytes | int] | None:
-        """GET bytes [start, end) of a pack. Returns
+        """GET bytes [start, end) of a pack (raw wire). Returns
         ``("partial", bytes)`` on 206 (length-checked),
         ``("full", whole_pack)`` on 200 whose body fits ``limit``,
         ``("oversized", content_length)`` — body UNREAD — on a 200
@@ -162,10 +163,25 @@ class ServeClient:
         caller's memory-budget reservation: without it a full-pack 200
         would sit resident against a reservation sized for the span
         alone."""
+        return self._ranged_get(f"/packs/{pack_hex}", start, end,
+                                limit)
+
+    def zpack_range(self, pack_hex: str, start: int, end: int,
+                    limit: int | None = None
+                    ) -> tuple[str, bytes | int] | None:
+        """GET compressed bytes [start, end) of a pack's seekable-zstd
+        twin (``/zpacks``); same return contract as
+        :meth:`pack_range`. A 404 — old server, frame-less pack — is
+        None, the caller's signal to use the raw wire."""
+        return self._ranged_get(f"/zpacks/{pack_hex}", start, end,
+                                limit)
+
+    def _ranged_get(self, path: str, start: int, end: int,
+                    limit: int | None = None
+                    ) -> tuple[str, bytes | int] | None:
         try:
             conn, resp = self._request(
-                f"/packs/{pack_hex}",
-                {"Range": f"bytes={start}-{end - 1}"})
+                path, {"Range": f"bytes={start}-{end - 1}"})
         except (OSError, http.client.HTTPException):
             self.transport_failures += 1
             return None
@@ -212,8 +228,9 @@ class ServeClient:
 
 
 def fetch_missing(fetch_range, rows: list, missing: set,
-                  put, pack_sizes: dict | None = None
-                  ) -> tuple[set, dict]:
+                  put, pack_sizes: dict | None = None,
+                  zframes: dict | None = None,
+                  fetch_zrange=None) -> tuple[set, dict]:
     """The fetch/carve core: plan runs for ``missing``, execute them in
     parallel across packs on the transfer engine (runs within one pack
     stay sequential so a failure stops further requests against it),
@@ -229,7 +246,21 @@ def fetch_missing(fetch_range, rows: list, missing: set,
     ``pack_sizes`` (the recipe's ``packs`` map) gives the planner the
     referenced packs' TRUE sizes — without it the whole-pack crossover
     is judged against only this recipe's referenced extent, firing
-    early on packs shared with other layers. Returns
+    early on packs shared with other layers.
+
+    ``zframes`` (the recipe's ``zpacks`` map: pack hex → frame-index
+    rows) plus ``fetch_zrange`` (the ``/zpacks`` transport,
+    ``ServeClient.zpack_range``) switch eligible packs onto the
+    **seekable-zstd wire**: missing spans map to frame runs
+    (``plan_frame_runs``), one ranged request per run moves COMPRESSED
+    bytes, each frame decompresses independently, and chunks carve out
+    of the decompressed frames — sha256-verified by ``put`` exactly
+    like raw spans, so a lying frame never installs. Any failure on
+    that wire (404 from an old server, truncated/corrupt frame, no
+    local libzstd) drops the pack back to the raw route — negotiation
+    by capability, never a hard break. ``stats["raw_wire_bytes"]``
+    records what the raw wire would have moved for the same plan, the
+    denominator the compressed-vs-raw CI gate reads. Returns
     ``(got_fps, stats)``."""
     from makisu_tpu.registry import transfer
     # First-occurrence coordinate wins per fingerprint, for BOTH the
@@ -255,9 +286,17 @@ def fetch_missing(fetch_range, rows: list, missing: set,
             spans_by_pack.setdefault(pack_hex, []).append(
                 (int(pack_off), int(length), fp))
     got: set[str] = set()
-    stats = {"requests": 0, "bytes_fetched": 0}
+    stats = {"requests": 0, "bytes_fetched": 0, "raw_wire_bytes": 0}
     mu = threading.Lock()
     budget = transfer.engine().budget
+    # Packs eligible for the compressed wire: the recipe published a
+    # frame index AND the caller wired a /zpacks transport AND this
+    # process can decode zstd. Everything else stays raw.
+    zcapable: dict[str, list] = {}
+    if zframes and fetch_zrange is not None:
+        from makisu_tpu.utils import zstdio
+        if zstdio.available():
+            zcapable = {ph: fr for ph, fr in zframes.items() if fr}
 
     def carve(pack_hex: str, data: bytes, base: int, spans) -> None:
         for off, length, fp in spans:
@@ -273,13 +312,101 @@ def fetch_missing(fetch_range, rows: list, missing: set,
             with mu:
                 got.add(fp)
 
-    def note(nbytes: int) -> None:
+    def note(nbytes: int, raw_equiv: int | None = None) -> None:
         with mu:
             stats["requests"] += 1
             stats["bytes_fetched"] += nbytes
+            stats["raw_wire_bytes"] += \
+                nbytes if raw_equiv is None else raw_equiv
+
+    def fetch_pack_frames(pack_hex: str, raw_equiv: int) -> bool:
+        """The compressed wire for one pack: frame runs fetched over
+        /zpacks, frames decompressed independently, chunks carved.
+        Returns False on ANY failure — the caller re-runs the raw
+        route for the pack (chunks already carved stay; put() is
+        idempotent, so the rare mid-pack fallback costs duplicate
+        spans, never correctness). ``raw_equiv`` is what the raw plan
+        would have moved for this pack (the stats denominator).
+
+        Stats flush only on SUCCESS: a pack that falls back mid-way
+        reports its raw re-run alone, so ``bytes_fetched <=
+        raw_wire_bytes`` holds exactly even under partial z failure
+        (the abandoned attempt's wire bytes stay visible in
+        ``makisu_serve_wire_bytes_total{encoding=zstd}`` — the report
+        prices plans, the counters price the wire)."""
+        from makisu_tpu.utils import zstdio
+        frames = zcapable[pack_hex]
+        spans = sorted(spans_by_pack[pack_hex])
+        try:
+            zruns = plan_frame_runs(frames, spans)
+        except (TypeError, ValueError, IndexError):
+            return False  # malformed frame rows: raw wire
+        if not zruns:
+            return False
+        # The frame index prices BOTH wires before any request:
+        # compressed cost is the planned z-run extents, raw cost is
+        # what the raw plan would move. Frames win only when they are
+        # actually cheaper — frame granularity over-covers scattered
+        # spans, and zstd on incompressible chunks grows them, so
+        # "compressed" is not automatically "fewer bytes". This is
+        # what makes `bytes_fetched <= raw_wire_bytes` an invariant
+        # the CI smoke can gate on, not a hope.
+        z_cost = sum(zrun[-1][2] + zrun[-1][3] - zrun[0][2]
+                     for zrun in zruns)
+        if z_cost >= raw_equiv > 0:
+            return False
+        zreqs = zbytes = 0
+        for zrun in zruns:
+            z_start = zrun[0][2]
+            z_end = zrun[-1][2] + zrun[-1][3]
+            raw_total = sum(r[1] for r in zrun)
+            # Reservation covers the compressed run AND the frames
+            # decompressed from it — both resident while carving.
+            with budget.reserve((z_end - z_start) + raw_total):
+                span = fetch_zrange(pack_hex, z_start, z_end,
+                                    limit=z_end - z_start)
+                if span is None:
+                    return False
+                kind, data = span
+                if kind == "oversized":
+                    # Range-ignoring server with a zpack bigger than
+                    # the run reservation: the raw route's oversized
+                    # machinery is the tested degradation.
+                    return False
+                base = 0 if kind == "full" else z_start
+                zreqs += 1
+                zbytes += len(data)
+                metrics.counter_add(metrics.SERVE_WIRE_BYTES,
+                                    len(data), encoding="zstd")
+                for raw_off, raw_len, z_off, z_len in zrun:
+                    zslice = data[z_off - base:z_off - base + z_len]
+                    if len(zslice) != z_len:
+                        return False
+                    try:
+                        rawbuf = zstdio.decompress(zslice, raw_len)
+                    except ValueError as e:
+                        log.warning("seekable pack %s frame at %d "
+                                    "undecodable (%s); raw fallback",
+                                    pack_hex, z_off, e)
+                        return False
+                    frame_end = raw_off + raw_len
+                    carve(pack_hex, rawbuf, raw_off,
+                          [s for s in spans
+                           if s[0] >= raw_off
+                           and s[0] + s[1] <= frame_end])
+        with mu:
+            stats["requests"] += zreqs
+            stats["bytes_fetched"] += zbytes
+            stats["raw_wire_bytes"] += raw_equiv
+        return True
 
     def fetch_pack_runs(job) -> None:
         pack_hex, runs = job
+        if pack_hex in zcapable:
+            raw_equiv = sum(
+                run[-1][0] + run[-1][1] - run[0][0] for run in runs)
+            if fetch_pack_frames(pack_hex, raw_equiv):
+                return
         for run in runs:
             start = run[0][0]
             end = run[-1][0] + run[-1][1]
@@ -292,12 +419,16 @@ def fetch_missing(fetch_range, rows: list, missing: set,
                 kind, data = span
                 if kind == "partial":
                     note(len(data))
+                    metrics.counter_add(metrics.SERVE_WIRE_BYTES,
+                                        len(data), encoding="raw")
                     carve(pack_hex, data, start, run)
                 elif kind == "full":
                     # Server ignored Range but the body fit the run
                     # reservation: the whole pack is in hand — carve
                     # everything wanted and stop issuing ranges.
                     note(len(data))
+                    metrics.counter_add(metrics.SERVE_WIRE_BYTES,
+                                        len(data), encoding="raw")
                     carve(pack_hex, data, 0,
                           sorted(spans_by_pack[pack_hex]))
             if kind == "full":
@@ -312,6 +443,13 @@ def fetch_missing(fetch_range, rows: list, missing: set,
     def fetch_whole(pack_hex: str, size: int = 0) -> None:
         spans = sorted(spans_by_pack[pack_hex])
         end = size or max(off + length for off, length, _ in spans)
+        if size == 0 and pack_hex in zcapable:
+            # Mostly-needed pack: the compressed wire moves the same
+            # frames for a fraction of the bytes; the raw extent is
+            # what a whole-pack raw fetch would have moved.
+            raw_equiv = (pack_sizes or {}).get(pack_hex, end)
+            if fetch_pack_frames(pack_hex, raw_equiv):
+                return
         # The second pass only fires for a Range-ignoring server whose
         # true pack size exceeds the referenced extent — retried once
         # at the size it declared, never unbounded.
@@ -323,6 +461,8 @@ def fetch_missing(fetch_range, rows: list, missing: set,
                 kind, data = span
                 if kind != "oversized":
                     note(len(data))
+                    metrics.counter_add(metrics.SERVE_WIRE_BYTES,
+                                        len(data), encoding="raw")
                     carve(pack_hex, data, 0, spans)
                     return
             end = int(data)
@@ -366,11 +506,13 @@ def delta_pull_layer(serve_client: ServeClient, chunk_store,
                if not chunk_store.cas.exists(fp)}
     bytes_missing = sum(lengths[fp] for fp in missing)
     got: set = set()
-    stats = {"requests": 0, "bytes_fetched": 0}
+    stats = {"requests": 0, "bytes_fetched": 0, "raw_wire_bytes": 0}
     if missing:
         got, stats = fetch_missing(serve_client.pack_range, rows,
                                    missing, chunk_store.put,
-                                   pack_sizes=recipe.get("packs"))
+                                   pack_sizes=recipe.get("packs"),
+                                   zframes=recipe.get("zpacks"),
+                                   fetch_zrange=serve_client.zpack_range)
         if got != missing:
             log.info("delta pull: %d/%d missing chunks unavailable "
                      "from the serve endpoint for %s",
@@ -402,6 +544,12 @@ def delta_pull_layer(serve_client: ServeClient, chunk_store,
         "chunks_missing": len(missing),
         "bytes_total": bytes_total,
         "bytes_fetched": stats["bytes_fetched"],
+        # What the RAW pack wire would have moved for the same plan —
+        # equal to bytes_fetched when no seekable frames were used,
+        # strictly the uncompressed denominator when they were (the
+        # compressed-vs-raw gate the CI smoke reads).
+        "raw_wire_bytes": stats.get("raw_wire_bytes",
+                                    stats["bytes_fetched"]),
         "bytes_reused": max(bytes_total - bytes_missing, 0),
         "requests": stats["requests"],
     }
@@ -422,12 +570,19 @@ def build_pull_report(image, serve_socket: str,
     fetched = sum(r.get("bytes_fetched", 0) for r in layers_report)
     full = sum(r.get("size", r.get("bytes_total", 0))
                for r in layers_report)
+    raw_wire = sum(r.get("raw_wire_bytes", r.get("bytes_fetched", 0))
+                   for r in layers_report)
     return {
         "schema": "makisu-tpu.serve-pull.v1",
         "image": str(image),
         "serve_socket": serve_socket,
         "layers": layers_report,
         "bytes_fetched": fetched,
+        # The raw-pack-wire denominator: bytes the same pull would
+        # have moved without seekable-zstd frames (== bytes_fetched
+        # for raw/blob routes). The delta-pull smoke gates
+        # bytes_fetched <= bytes_raw_wire.
+        "bytes_raw_wire": raw_wire,
         "bytes_full_image": full,
         "fetched_fraction": round(fetched / full, 6) if full else 0.0,
         "delta_layers": sum(1 for r in layers_report

@@ -24,6 +24,23 @@ hex) is the serving side's other artifact: packs are *synthesized* from
 the chunk CAS on demand — a pack's bytes are the concatenation of its
 members — so the store never keeps pack blobs resident; serving a
 range costs reads of only the overlapped chunks.
+
+**Seekable-zstd packs**: alongside each new pack, publish writes a
+compressed twin — the pack's bytes re-encoded as independently-
+decompressible zstd frames (frame boundaries on chunk boundaries,
+~``pack_frame_target_bytes()`` of raw bytes per frame) persisted under
+``zpacks/<pack_hex>.zst`` — and a **frame index**
+(``[raw_off, raw_len, z_off, z_len]`` rows) recorded in the pack table
+and embedded in every referencing recipe (``zpacks`` key). A
+frame-aware client maps missing chunk spans to frame ranges and pulls
+*compressed* bytes over ``GET /zpacks/<hex>`` Range requests, each
+frame decompressing without upstream context; clients or servers
+without the capability (no libzstd, old peer, pre-frame pack) simply
+keep the raw ``/packs`` wire — negotiation is by presence, never a
+hard break. Frames are an encoding of pack bytes, not an identity:
+pack hexes still name the RAW concatenation, and every carved chunk is
+sha256-verified before the CAS stores it, so a lying frame can waste
+bytes, never install bytes.
 """
 
 from __future__ import annotations
@@ -53,6 +70,36 @@ def signing_key() -> bytes:
     """The serve plane's shared HMAC key (``MAKISU_TPU_SERVE_KEY``);
     empty means unsigned recipes (self-digest integrity only)."""
     return os.environ.get("MAKISU_TPU_SERVE_KEY", "").encode()
+
+
+def pack_frame_target_bytes() -> int:
+    """Raw bytes per seekable-pack frame (MAKISU_TPU_PACK_FRAME_KB,
+    default 256KiB): small enough that a scattered 1-edit delta
+    over-decompresses little, large enough that zstd's ratio doesn't
+    collapse to per-chunk framing. Floored at 16KiB — below the
+    average chunk size the frame table would outgrow its savings."""
+    try:
+        target = int(float(os.environ.get(
+            "MAKISU_TPU_PACK_FRAME_KB", "256")) * 1024)
+    except ValueError:
+        return 256 * 1024
+    return max(target, 16 * 1024)
+
+
+def _frame_rows_valid(frames) -> bool:
+    """Structural check for one pack's frame-index rows."""
+    if not isinstance(frames, list) or not frames:
+        return False
+    for row in frames:
+        if not (isinstance(row, list) and len(row) == 4):
+            return False
+        raw_off, raw_len, z_off, z_len = row
+        for v in (raw_off, raw_len, z_off, z_len):
+            if not isinstance(v, int) or v < 0:
+                return False
+        if raw_len <= 0 or z_len <= 0:
+            return False
+    return True
 
 
 def canonical_body(doc: dict) -> bytes:
@@ -113,6 +160,16 @@ def well_formed(doc: dict) -> bool:
             if not is_hex_digest(str(pack_hex)) \
                     or not isinstance(size, int) or size <= 0:
                 return False
+    zpacks = doc.get("zpacks")
+    if zpacks is not None:
+        # Optional (absent pre-seekable or when libzstd was missing at
+        # publish): per-pack frame indexes for the compressed wire.
+        if not isinstance(zpacks, dict):
+            return False
+        for pack_hex, frames in zpacks.items():
+            if not is_hex_digest(str(pack_hex)) \
+                    or not _frame_rows_valid(frames):
+                return False
     return True
 
 
@@ -165,13 +222,41 @@ class RecipeStore:
         self.chunk_root = os.path.realpath(chunk_root)
         self._recipes_dir = os.path.join(root, "recipes")
         self._packs_dir = os.path.join(root, "packs")
+        self._zpacks_dir = os.path.join(root, "zpacks")
         self._mu = threading.Lock()
         self._chunk_index: dict[str, tuple[str, int, int]] = {}
         self._pack_members: dict[str, list[tuple[str, int]]] = {}
         self._pack_sizes: dict[str, int] = {}
+        # Seekable twin: per-pack frame index rows
+        # (raw_off, raw_len, z_off, z_len) describing zpacks/<hex>.zst.
+        self._pack_frames: dict[str, list[list[int]]] = {}
         self._loaded = False
 
     # -- persistence ------------------------------------------------------
+
+    @staticmethod
+    def _parse_pack_table(doc):
+        """Both pack-table shapes: the legacy bare member list, and the
+        dict form that adds the seekable frame index. Returns
+        ``(members, frames_or_None)``; raises on malformed input (the
+        caller treats that as "pack not served")."""
+        if isinstance(doc, dict):
+            members = [(str(fp), int(length))
+                       for fp, length in doc["members"]]
+            frames = doc.get("frames")
+            if frames is not None:
+                # A malformed frame index demotes the pack to
+                # raw-only serving — it must never take the intact
+                # member table down with it.
+                try:
+                    frames = [[int(v) for v in row] for row in frames]
+                except (TypeError, ValueError):
+                    frames = None
+                else:
+                    if not _frame_rows_valid(frames):
+                        frames = None
+            return members, frames
+        return [(str(fp), int(length)) for fp, length in doc], None
 
     def _load_locked(self) -> None:
         if self._loaded:
@@ -190,22 +275,61 @@ class RecipeStore:
             try:
                 with open(os.path.join(self._packs_dir, name),
                           encoding="utf-8") as f:
-                    members = [(str(fp), int(length))
-                               for fp, length in json.load(f)]
-            except (OSError, ValueError, TypeError):
+                    members, frames = self._parse_pack_table(
+                        json.load(f))
+            except (OSError, ValueError, TypeError, KeyError):
                 continue  # torn/corrupt table: pack simply not served
-            self._index_pack_locked(pack_hex, members)
+            self._index_pack_locked(pack_hex, members, frames)
 
     def _index_pack_locked(self, pack_hex: str,
-                           members: list[tuple[str, int]]) -> None:
+                           members: list[tuple[str, int]],
+                           frames=None) -> None:
         self._pack_members[pack_hex] = members
         off = 0
         for fp, length in members:
             self._chunk_index.setdefault(fp, (pack_hex, off, length))
             off += length
         self._pack_sizes[pack_hex] = off
+        if frames:
+            self._pack_frames[pack_hex] = [
+                [int(v) for v in row] for row in frames]
 
     # -- publish ----------------------------------------------------------
+
+    @staticmethod
+    def _encode_frames(raw: bytes, members: list[tuple[str, int]]
+                       ) -> tuple[list[list[int]] | None, bytes | None]:
+        """Encode one pack's raw bytes as independent zstd frames with
+        boundaries on chunk boundaries (~pack_frame_target_bytes() of
+        raw bytes each — whole chunks, so any chunk decompresses from
+        exactly one frame). Returns ``(frame_rows, zblob)`` or
+        ``(None, None)`` when libzstd is unavailable (the pack serves
+        raw-only; never a publish failure)."""
+        from makisu_tpu.utils import zstdio
+        if not zstdio.available():
+            return None, None
+        target = pack_frame_target_bytes()
+        frames: list[list[int]] = []
+        zparts: list[bytes] = []
+        raw_off = z_off = 0
+        frame_len = 0
+        for _, length in members:
+            frame_len += length
+            if frame_len >= target:
+                z = zstdio.compress(
+                    raw[raw_off:raw_off + frame_len])
+                frames.append([raw_off, frame_len, z_off, len(z)])
+                zparts.append(z)
+                raw_off += frame_len
+                z_off += len(z)
+                frame_len = 0
+        if frame_len:
+            z = zstdio.compress(raw[raw_off:raw_off + frame_len])
+            frames.append([raw_off, frame_len, z_off, len(z)])
+            zparts.append(z)
+        if not frames:
+            return None, None
+        return frames, b"".join(zparts)
 
     def publish(self, pair, triples: list[tuple[int, int, str]],
                 gz_backend: str | None, chunk_store) -> dict | None:
@@ -241,7 +365,8 @@ class RecipeStore:
         # pass (gigabytes on a cold large layer) — pack serving must
         # not stall behind it. Pack tables persist before anything
         # references them.
-        new_packs: list[tuple[str, list[tuple[str, int]]]] = []
+        new_packs: list[tuple[str, list[tuple[str, int]],
+                              list[list[int]] | None]] = []
         buf = bytearray()
         members: list[tuple[str, int]] = []
 
@@ -249,8 +374,18 @@ class RecipeStore:
             nonlocal buf, members
             if not members:
                 return
-            pack_hex = hashlib.sha256(bytes(buf)).hexdigest()
-            new_packs.append((pack_hex, list(members)))
+            raw = bytes(buf)
+            pack_hex = hashlib.sha256(raw).hexdigest()
+            frames, zblob = self._encode_frames(raw, members)
+            if zblob is not None:
+                os.makedirs(self._zpacks_dir, exist_ok=True)
+                # Frame bytes land BEFORE the table that indexes them:
+                # a reader may see a zpack with no table (unused), but
+                # never a table pointing at a missing/torn file.
+                fileio.write_bytes_atomic(
+                    os.path.join(self._zpacks_dir, f"{pack_hex}.zst"),
+                    zblob)
+            new_packs.append((pack_hex, list(members), frames))
             buf = bytearray()
             members = []
 
@@ -273,10 +408,15 @@ class RecipeStore:
         flush()
         if new_packs:
             os.makedirs(self._packs_dir, exist_ok=True)
-            for pack_hex, pack_members in new_packs:
+            for pack_hex, pack_members, frames in new_packs:
+                rows_out = [[fp, length] for fp, length in pack_members]
+                # Legacy bare-list shape when no frames (old readers
+                # parse it); dict shape carries the frame index.
+                table = ({"members": rows_out, "frames": frames}
+                         if frames else rows_out)
                 fileio.write_json_atomic(
                     os.path.join(self._packs_dir, f"{pack_hex}.json"),
-                    [[fp, length] for fp, length in pack_members])
+                    table)
         # Phase 3 (lock): index the new packs and resolve every row.
         # A racing publish may have indexed some of our "novel"
         # chunks into its own pack meanwhile — setdefault keeps the
@@ -285,9 +425,10 @@ class RecipeStore:
         # by this recipe).
         rows: list[list] = []
         pack_sizes: dict[str, int] = {}
+        zpacks: dict[str, list] = {}
         with self._mu:
-            for pack_hex, pack_members in new_packs:
-                self._index_pack_locked(pack_hex, pack_members)
+            for pack_hex, pack_members, frames in new_packs:
+                self._index_pack_locked(pack_hex, pack_members, frames)
             for _, length, fp in triples:
                 coords = self._chunk_index.get(fp)
                 if coords is None:
@@ -296,6 +437,9 @@ class RecipeStore:
                 size = self._pack_sizes.get(coords[0], 0)
                 if size > 0:
                     pack_sizes[coords[0]] = size
+                frames = self._pack_frames.get(coords[0])
+                if frames:
+                    zpacks[coords[0]] = frames
         doc = seal({
             "schema": RECIPE_SCHEMA,
             "layer": {
@@ -311,6 +455,11 @@ class RecipeStore:
             # the real pack size (the registry path feeds the planner
             # exact sizes from the member tables).
             "packs": pack_sizes,
+            # Frame indexes of every referenced pack that has a
+            # seekable twin: the client's capability signal AND its
+            # span→frame map — absent entries (old packs, libzstd-less
+            # publishers) keep those packs on the raw wire.
+            "zpacks": zpacks,
         })
         os.makedirs(self._recipes_dir, exist_ok=True)
         fileio.write_json_atomic(
@@ -346,11 +495,10 @@ class RecipeStore:
             with open(os.path.join(self._packs_dir,
                                    f"{pack_hex}.json"),
                       encoding="utf-8") as f:
-                members = [(str(fp), int(length))
-                           for fp, length in json.load(f)]
-        except (OSError, ValueError, TypeError):
+                members, frames = self._parse_pack_table(json.load(f))
+        except (OSError, ValueError, TypeError, KeyError):
             return
-        self._index_pack_locked(pack_hex, members)
+        self._index_pack_locked(pack_hex, members, frames)
 
     def pack_members(self, pack_hex: str) -> list | None:
         if not is_hex_digest(pack_hex):
@@ -365,6 +513,47 @@ class RecipeStore:
             self._load_locked()
             self._refresh_pack_locked(pack_hex)
             return self._pack_sizes.get(pack_hex, 0)
+
+    def pack_frames(self, pack_hex: str) -> list | None:
+        """The seekable frame index for ``pack_hex``, or None when the
+        pack has no compressed twin (pre-frame pack, libzstd-less
+        publisher)."""
+        if not is_hex_digest(pack_hex):
+            return None
+        with self._mu:
+            self._load_locked()
+            self._refresh_pack_locked(pack_hex)
+            return self._pack_frames.get(pack_hex)
+
+    def zpack_size(self, pack_hex: str) -> int:
+        """Total compressed size of the pack's frame file (the Range
+        denominator for ``/zpacks``); 0 when no frames."""
+        frames = self.pack_frames(pack_hex)
+        if not frames:
+            return 0
+        last = frames[-1]
+        return int(last[2]) + int(last[3])
+
+    def iter_zpack_range(self, pack_hex: str, start: int, end: int,
+                         piece_size: int = 1 << 20):
+        """Yield bytes ``[start, end)`` of the pack's compressed frame
+        file in bounded pieces. Raises ``FileNotFoundError`` when the
+        file is gone and ``ValueError`` when it is shorter than the
+        frame index promises (both degrade the request to a 404/closed
+        stream, and the client to the raw or blob route)."""
+        path = os.path.join(self._zpacks_dir, f"{pack_hex}.zst")
+        with open(path, "rb") as fh:
+            if start:
+                fh.seek(start)
+            remaining = end - start
+            while remaining > 0:
+                piece = fh.read(min(remaining, piece_size))
+                if not piece:
+                    raise ValueError(
+                        f"zpack {pack_hex} shorter than its frame "
+                        f"index")
+                remaining -= len(piece)
+                yield piece
 
     def stats(self) -> dict:
         """Digest for /healthz: how much this store can serve."""
@@ -393,6 +582,7 @@ class RecipeStore:
                 "recipes": recipes,
                 "packs": len(self._pack_members),
                 "pack_bytes": sum(self._pack_sizes.values()),
+                "zpacks": len(self._pack_frames),
             }
 
     def iter_pack_range(self, pack_hex: str, start: int, end: int,

@@ -106,7 +106,7 @@ def reset_stores() -> None:
 
 def serve_stats(roots=None) -> dict:
     """Aggregate digest for /healthz."""
-    out = {"recipes": 0, "packs": 0, "pack_bytes": 0}
+    out = {"recipes": 0, "packs": 0, "pack_bytes": 0, "zpacks": 0}
     for store in stores(roots):
         stats = store.stats()
         for key in out:
@@ -203,6 +203,7 @@ def handle_pack(handler, name: str, roots=None) -> None:
         g.counter_add(metrics.SERVE_PACK_REQUESTS,
                       kind="range" if span is not None else "full")
         g.counter_add(metrics.SERVE_PACK_BYTES, sent)
+        g.counter_add(metrics.SERVE_WIRE_BYTES, sent, encoding="raw")
     except (FileNotFoundError, ValueError) as e:
         # Member chunk evicted (FileNotFoundError) or truncated on
         # disk (ValueError) after the headers went out: the body is
@@ -214,6 +215,67 @@ def handle_pack(handler, name: str, roots=None) -> None:
         g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="gone")
         log.warning("pack %s no longer fully backed by the chunk CAS "
                     "(%s)", name, e)
+    except (BrokenPipeError, ConnectionResetError):
+        pass  # client hung up mid-stream; not our problem
+
+
+def handle_zpack(handler, name: str, roots=None) -> None:
+    """``GET /zpacks/<pack_hex>`` with optional Range: the pack's
+    seekable-zstd twin — independently-decompressible frames, ranges
+    over COMPRESSED bytes — streamed from the frame file under the
+    transfer memory budget. 404 when the pack has no frames (pre-frame
+    pack, libzstd-less publisher, unknown hex): the client's signal to
+    keep the raw ``/packs`` wire, never a hard break."""
+    from makisu_tpu.registry import transfer
+    g = metrics.global_registry()
+    if not recipe_mod.is_hex_digest(name):
+        _respond(handler, 400, b"bad pack digest")
+        return
+    store = frames = None
+    for cand in stores(roots):
+        frames = cand.pack_frames(name)
+        if frames is not None:
+            store = cand
+            break
+    if store is None:
+        g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="zmiss")
+        _respond(handler, 404, b"no seekable pack held here")
+        return
+    size = store.zpack_size(name)
+    span = parse_range(handler.headers.get("Range"), size)
+    if span == "unsatisfiable":
+        g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="bad_range")
+        _respond(handler, 416, b"range not satisfiable")
+        return
+    start, end = span if span is not None else (0, size)
+    budget = transfer.engine().budget
+    try:
+        with budget.reserve(min(end - start, transfer.STREAM_RESERVE)):
+            handler.send_response(206 if span is not None else 200)
+            handler.send_header("Content-Type",
+                                "application/zstd")
+            handler.send_header("Content-Length", str(end - start))
+            if span is not None:
+                handler.send_header(
+                    "Content-Range", f"bytes {start}-{end - 1}/{size}")
+            handler.end_headers()
+            sent = 0
+            for piece in store.iter_zpack_range(name, start, end):
+                handler.wfile.write(piece)
+                sent += len(piece)
+        served_frames = sum(1 for row in frames
+                            if row[2] < end and row[2] + row[3] > start)
+        g.counter_add(metrics.SERVE_PACK_REQUESTS,
+                      kind="zrange" if span is not None else "zfull")
+        g.counter_add(metrics.SERVE_PACK_FRAMES, served_frames)
+        g.counter_add(metrics.SERVE_WIRE_BYTES, sent, encoding="zstd")
+    except (FileNotFoundError, ValueError) as e:
+        # Frame file gone/truncated after headers went out: close so
+        # the short body is immediate (same discipline as handle_pack).
+        handler.close_connection = True
+        g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="gone")
+        log.warning("seekable pack %s no longer fully on disk (%s)",
+                    name, e)
     except (BrokenPipeError, ConnectionResetError):
         pass  # client hung up mid-stream; not our problem
 
@@ -244,6 +306,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
             handle_recipe(self, self.path[len("/recipes/"):])
         elif self.path.startswith("/packs/"):
             handle_pack(self, self.path[len("/packs/"):])
+        elif self.path.startswith("/zpacks/"):
+            handle_zpack(self, self.path[len("/zpacks/"):])
         elif self.path == "/metrics":
             _respond(self, 200,
                      metrics.render_prometheus().encode(),

@@ -43,24 +43,28 @@ COMPRESSION_LEVELS = {"no": 0, "speed": 1, "default": 6, "size": 9}
 
 _compression_level = COMPRESSION_LEVELS["default"]
 
-# Compressor backend: "zlib" (stdlib, single-stream) or "pgzip" (native
-# parallel block deflate, native/pgzip.cpp — the reference's multicore
-# pgzip capability). Both are deterministic, but produce different bytes,
-# so the backend id is part of a layer's cache identity (cache entries
-# record it; chunk reconstitution replays with the same backend).
+# Compressor backend: "zlib" (stdlib, one continuous deflate stream —
+# inherently serial: its bytes are cache identity and a continuous
+# stream cannot be split across lanes) or "pgzip" (blockwise deflate —
+# the reference's multicore pgzip capability; block-parallel via
+# BlockGzipWriter on the shared hash pool, native/pgzip.cpp providing
+# the fast codec). Both are deterministic, but produce different
+# bytes, so the backend id is part of a layer's cache identity (cache
+# entries record it; chunk reconstitution replays with the same
+# backend).
 _gzip_backend = "zlib"
 _PGZIP_BLOCK = 128 * 1024
 
 
 def _validate_backend(name: str) -> None:
+    # pgzip no longer requires the native library: the block format is
+    # reproducible by the stdlib zlib codec (byte-identical slices, see
+    # _py_deflate_blocks), so any host can WRITE and REPLAY pgzip ids —
+    # the native entry points are a throughput route, not a capability.
+    # ``auto`` still resolves to zlib on lib-less hosts (the Python
+    # codec is correct but not the speed pick; see resolve_backend).
     if name not in ("zlib", "pgzip"):
         raise ValueError(f"unknown gzip backend {name!r}")
-    if name == "pgzip":
-        from makisu_tpu.native import pgzip_available
-        if not pgzip_available():
-            raise ValueError(
-                "pgzip backend requested but native/libpgzip.so is not "
-                "available (run `make -C native`)")
 
 
 def set_gzip_backend(name: str) -> None:
@@ -104,14 +108,16 @@ def parse_backend_id(backend_id: str) -> tuple[str, int, int]:
 
 def backend_id_usable(backend_id: str | None) -> bool:
     """True when a recorded backend id can be replayed by gzip_writer in
-    THIS process — known backend name, well-formed level/block, and (for
-    pgzip) the native library present. Cache routes that promise future
-    reconstitution (chunk dedup's lazy hits) consult this up front so an
-    entry written by a host with a backend we lack degrades to the blob
-    route at pull time, not to a failed build at export time. ``None``
-    (legacy entry with no recorded identity) is NOT replayable: the
-    producing settings are unknown, so a byte-identical rebuild cannot
-    be promised."""
+    THIS process — known backend name, well-formed level/block. Every
+    host can replay both backends now (the pgzip block format has a
+    stdlib-zlib codec, byte-identical to the native one), so this
+    reduces to well-formedness; cache routes that promise future
+    reconstitution (chunk dedup's lazy hits) still consult it so a
+    MALFORMED or future-versioned id degrades to the blob route at pull
+    time, not to a failed build at export time. ``None`` (legacy entry
+    with no recorded identity) is NOT replayable: the producing
+    settings are unknown, so a byte-identical rebuild cannot be
+    promised."""
     if backend_id is None:
         return False
     try:
@@ -163,42 +169,230 @@ def compression_level() -> int:
     return _compression_level
 
 
-class _FixedGranularityWriter:
-    """Re-buffers writes into fixed-size blocks before the compressor.
+class _BlockBuffer:
+    """Fixed-granularity re-blocking: the determinism contract shared
+    by the level-0 stored-block writer and the block-parallel compress
+    stage. Compressed output that depends on input call sizes (zlib
+    level-0 stored-block framing; pgzip's per-block slices) becomes a
+    pure function of content once the compressor is fed in exactly
+    ``granularity``-sized blocks, regardless of who writes (tarfile's
+    ~16KiB writes vs reconstitution's single whole-layer write)."""
 
-    zlib level 0 emits stored blocks whose framing depends on the SIZE
-    of each compress() call (measured: 64KiB vs 1MiB writes yield
-    different bytes), so without this wrapper the gzip digest of a
-    level-0 blob would depend on who wrote it (tarfile's ~16KiB writes
-    vs reconstitution's single whole-layer write) — splitting cache
-    identity. Feeding the compressor in exactly ``granularity`` chunks
-    makes the output a pure function of content again.
+    def __init__(self, granularity: int) -> None:
+        if granularity <= 0:
+            raise ValueError("granularity must be positive")
+        self.granularity = granularity
+        self._buf = bytearray()
+
+    def feed(self, data) -> list[bytes]:
+        """Absorb ``data``; return the complete blocks now available."""
+        self._buf += data
+        g = self.granularity
+        blocks = []
+        while len(self._buf) >= g:
+            blocks.append(bytes(self._buf[:g]))
+            del self._buf[:g]
+        return blocks
+
+    def tail(self) -> bytes:
+        """Drain the final partial block (stream end)."""
+        t = bytes(self._buf)
+        self._buf.clear()
+        return t
+
+
+class _FixedGranularityWriter:
+    """Re-buffers writes into fixed-size blocks before the compressor
+    (the zlib level-0 stored-block determinism fix; see _BlockBuffer).
     """
 
     GRANULARITY = 64 * 1024
 
     def __init__(self, gz) -> None:
         self._gz = gz
-        self._buf = bytearray()
+        self._blocks = _BlockBuffer(self.GRANULARITY)
 
     def write(self, data: bytes) -> int:
-        self._buf += data
-        g = self.GRANULARITY
-        while len(self._buf) >= g:
-            self._gz.write(bytes(self._buf[:g]))
-            del self._buf[:g]
+        for block in self._blocks.feed(data):
+            self._gz.write(block)
         return len(data)
 
     def close(self) -> None:
-        if self._buf:
-            self._gz.write(bytes(self._buf))
-            self._buf.clear()
+        tail = self._blocks.tail()
+        if tail:
+            self._gz.write(tail)
         self._gz.close()
 
     def flush(self) -> None:  # pragma: no cover - parity shim
         pass
 
     def __enter__(self) -> "_FixedGranularityWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# Gzip member header for the pgzip block format (mirrors the native
+# side's kPgzipHeader: deflate, no flags, mtime 0, XFL 0, OS 255).
+_PGZIP_HEADER = bytes([0x1f, 0x8b, 0x08, 0, 0, 0, 0, 0, 0, 0xff])
+
+
+def _py_deflate_blocks(data: bytes, level: int, block_size: int,
+                       last: bool) -> bytes:
+    """Pure-Python codec for the pgzip block format: compress ``data``
+    as consecutive ``block_size`` raw-deflate slices, each sync-flush
+    terminated; a final batch (``last``) additionally emits the tail
+    ``len(data) % block_size`` bytes — possibly empty — as the Z_FINISH
+    slice (the exact streaming convention PgzipWriter/layersink.cpp
+    shipped; blob cache identity). Byte-identical to native
+    ``DeflateSlice`` concatenation — both drive the same zlib with the
+    same parameters (windowBits -15, memLevel 8, default strategy),
+    asserted by tests. This is what makes pgzip backend ids replayable
+    on hosts without the native library."""
+    import zlib
+    n = len(data)
+    nfull = n // block_size
+    if not last and nfull * block_size != n:
+        raise ValueError("non-final batches must be whole blocks")
+    nblocks = nfull + 1 if last else nfull
+    if nblocks == 0:
+        raise ValueError("empty non-final batch")
+    out = []
+    for i in range(nblocks):
+        co = zlib.compressobj(level, zlib.DEFLATED, -15, 8,
+                              zlib.Z_DEFAULT_STRATEGY)
+        piece = co.compress(data[i * block_size:(i + 1) * block_size])
+        fin = last and i + 1 == nblocks
+        piece += co.flush(zlib.Z_FINISH if fin else zlib.Z_SYNC_FLUSH)
+        out.append(piece)
+    return b"".join(out)
+
+
+def _deflate_blocks(data: bytes, level: int, block_size: int,
+                    last: bool) -> bytes:
+    """One batch of pgzip blocks: native multi-block entry when the
+    library has it (one GIL-released call), stdlib zlib otherwise —
+    identical bytes either way."""
+    from makisu_tpu import native
+    if native.pgz_blocks_available():
+        return native.deflate_blocks(data, level, block_size, last)
+    return _py_deflate_blocks(data, level, block_size, last)
+
+
+class BlockGzipWriter:
+    """Block-parallel deterministic gzip writer (the commit pipeline's
+    compress stage for the pgzip backend).
+
+    Input re-blocks through :class:`_BlockBuffer` into ``block_size``
+    slices; batches of blocks compress concurrently on the shared
+    ``concurrency.hash_pool()`` (each batch one GIL-released native
+    call — or the stdlib codec, byte-identical) and stitch back in
+    stream order. Output is a single gzip member, a pure function of
+    (content, level, block_size): identical at every worker count and
+    identical to ``native.pgzip_compress`` / the native layersink's
+    pgzip route. ``workers`` defaults to the context's
+    ``compress_workers()``; 1 compresses inline (no pool).
+
+    Busy seconds land on the ``compress`` stage counter from the lane
+    tasks themselves (``reports_compress_busy`` tells LayerSink's feed
+    thread not to double-count its cheap buffering writes)."""
+
+    # Blocks per lane task: batches amortize call overhead while one
+    # batch stays a bounded slice of memory (~1MiB at the 128KiB
+    # default block).
+    BATCH_BLOCKS = 8
+    reports_compress_busy = True
+
+    def __init__(self, fileobj: BinaryIO, level: int = 6,
+                 block_size: int = _PGZIP_BLOCK,
+                 workers: int | None = None) -> None:
+        import zlib
+        from makisu_tpu.utils import concurrency
+        self._out = fileobj
+        self._level = level
+        self._block = block_size
+        self._blocks = _BlockBuffer(block_size)
+        self._crc = zlib.crc32(b"")
+        self._size = 0
+        if workers is None:
+            workers = concurrency.compress_workers()
+        self._workers = max(1, workers)
+        self._pool = concurrency.hash_pool() if self._workers > 1 \
+            else None
+        self._batch: list[bytes] = []   # whole blocks awaiting a lane
+        self._pending: list = []        # ordered lane futures
+        self._submits = 0               # queue-depth sampling stride
+        self._closed = False
+        self._out.write(_PGZIP_HEADER)
+
+    def _compress_task(self, payload: bytes, last: bool) -> bytes:
+        import time as _time
+        from makisu_tpu.utils import metrics
+        t0 = _time.monotonic()
+        try:
+            return _deflate_blocks(payload, self._level, self._block,
+                                   last)
+        finally:
+            metrics.stage_busy_add(metrics.COMPRESS_STAGE,
+                                   _time.monotonic() - t0)
+            nblocks = len(payload) // self._block + (1 if last else 0)
+            metrics.counter_add(metrics.COMPRESS_BLOCKS, nblocks,
+                                backend="pgzip")
+
+    def _submit(self, payload: bytes, last: bool) -> None:
+        if self._pool is None:
+            # Inline lane: identical bytes, no pool round trip.
+            self._out.write(self._compress_task(payload, last))
+            return
+        from makisu_tpu.utils import concurrency, metrics
+        self._pending.append(concurrency.submit_ctx(
+            self._pool, self._compress_task, payload, last))
+        self._submits += 1
+        if not self._submits & 0x0F:
+            metrics.stage_queue_depth(metrics.COMPRESS_STAGE,
+                                      len(self._pending))
+        # Bound in-flight batches: each lane may own one plus one
+        # queued — the stage's memory ceiling, and the backpressure
+        # that keeps a fast producer from flooding the shared pool.
+        while len(self._pending) > 2 * self._workers:
+            self._out.write(self._pending.pop(0).result())
+        # Opportunistically retire completed fronts without blocking.
+        while self._pending and self._pending[0].done():
+            self._out.write(self._pending.pop(0).result())
+
+    def _flush_batch(self, last: bool) -> None:
+        if self._batch or last:
+            self._submit(b"".join(self._batch), last)
+            self._batch = []
+
+    def write(self, data: bytes) -> int:
+        import zlib
+        self._crc = zlib.crc32(data, self._crc)
+        self._size += len(data)
+        for block in self._blocks.feed(data):
+            self._batch.append(block)
+            if len(self._batch) >= self.BATCH_BLOCKS:
+                self._flush_batch(last=False)
+        return len(data)
+
+    def flush(self) -> None:
+        self._out.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._batch.append(self._blocks.tail())
+        self._flush_batch(last=True)
+        for fut in self._pending:
+            self._out.write(fut.result())
+        self._pending = []
+        trailer = (self._crc & 0xFFFFFFFF).to_bytes(4, "little") + \
+            (self._size & 0xFFFFFFFF).to_bytes(4, "little")
+        self._out.write(trailer)
+
+    def __enter__(self) -> "BlockGzipWriter":
         return self
 
     def __exit__(self, *exc) -> None:
@@ -217,8 +411,7 @@ def gzip_writer(fileobj: BinaryIO, level: int | None = None,
         if backend == "pgzip":
             block = parsed_block
     if backend == "pgzip":
-        from makisu_tpu.native import PgzipWriter
-        return PgzipWriter(fileobj, level=level, block_size=block)
+        return BlockGzipWriter(fileobj, level=level, block_size=block)
     gz = gzip.GzipFile(fileobj=fileobj, mode="wb", compresslevel=level,
                        mtime=0, filename="")
     if level == 0:

@@ -61,6 +61,47 @@ def reset_hash_workers(token) -> None:
     _hash_workers_override.reset(token)
 
 
+# -- layer-commit compression workers --------------------------------------
+#
+# The block-parallel compress stage (tario.BlockGzipWriter) has its own
+# knob, separate from --hash-workers: deflate runs entirely in C with
+# the GIL released, so it scales on hosts where the GIL-bound pipeline
+# stages do not (the sub-4-core hash default is 1; compression still
+# wins there). Worker count is a THROUGHPUT knob only — block bytes are
+# a pure function of (level, block size), identical at every count.
+
+_compress_workers_override: "contextvars.ContextVar[int | None]" = \
+    contextvars.ContextVar("makisu_compress_workers", default=None)
+
+
+def default_compress_workers() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+def compress_workers() -> int:
+    """Effective block-compress lane count for this context."""
+    override = _compress_workers_override.get()
+    if override is not None:
+        return max(1, override)
+    env = os.environ.get("MAKISU_TPU_COMPRESS_WORKERS", "")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass  # config never fails a build
+    return default_compress_workers()
+
+
+def set_compress_workers(n: int | None):
+    """Bind a per-context lane count (the CLI flag). Returns a token
+    for :func:`reset_compress_workers`."""
+    return _compress_workers_override.set(n)
+
+
+def reset_compress_workers(token) -> None:
+    _compress_workers_override.reset(token)
+
+
 # Shared hash-service batch linger (ms). Lives here — stdlib-only, no
 # chunker import — so the CLI can read/set it without dragging jax into
 # non-build invocations. Process-wide by design: the hash service

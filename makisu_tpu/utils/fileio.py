@@ -45,6 +45,26 @@ def write_json_atomic(path: str, payload, default=str) -> None:
         raise
 
 
+def write_bytes_atomic(path: str, payload: bytes) -> None:
+    """Crash-safe byte-blob write, same discipline as
+    :func:`write_json_atomic` (unique temp name, fsync, rename) — used
+    for artifacts a reader must never see torn (seekable-pack frame
+    files, whose offsets an index references)."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 @dataclasses.dataclass(frozen=True)
 class Owner:
     uid: int

@@ -50,6 +50,15 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
 COMMIT_STAGE_BUSY = "makisu_commit_stage_busy_seconds"
 COMMIT_QUEUE_DEPTH = "makisu_commit_queue_depth"
 
+# The compress stage's label under the stage pair above, plus the
+# block-parallel stage's own series (tario.BlockGzipWriter and the
+# LayerSink compression worker share the label; the block/byte counters
+# label backend=zlib|pgzip so the bench compress_micro section and the
+# report can split the two formats).
+COMPRESS_STAGE = "compress"
+COMPRESS_BLOCKS = "makisu_compress_blocks_total"
+COMPRESS_BYTES = "makisu_compress_bytes_total"
+
 # Device execution telemetry (ops/backend.py note_device_dispatch):
 # one name set shared by the HashService, the chunker's lane batcher,
 # the /healthz device section, and the docs' metric table — per lane
@@ -95,6 +104,13 @@ SERVE_DELTA_PULLS = "makisu_serve_delta_pulls_total"
 SERVE_DELTA_BYTES = "makisu_serve_delta_bytes_total"
 SERVE_PEER_PACK_REQUESTS = "makisu_serve_peer_pack_requests_total"
 SERVE_PEER_PACK_BYTES = "makisu_serve_peer_pack_bytes_total"
+# Seekable-zstd pack plane: independently-decompressible frames served
+# (the /zpacks endpoint), and wire bytes split by encoding — the
+# raw-vs-compressed economics the delta-pull smoke gates on
+# (encoding=raw|zstd, counted client-side as fetched and server-side
+# as served).
+SERVE_PACK_FRAMES = "makisu_serve_pack_frames_total"
+SERVE_WIRE_BYTES = "makisu_serve_wire_bytes_total"
 
 # Deploy-identity info gauge (cli.main): constant 1, identity in the
 # labels — the node_exporter "build_info" idiom.

@@ -1,4 +1,4 @@
-"""Streaming zstd decompression over the system libzstd via ctypes.
+"""Streaming zstd over the system libzstd via ctypes: decode + encode.
 
 Registries increasingly publish base-image layers as
 ``application/vnd.oci.image.layer.v1.tar+zstd`` (containerd and buildkit
@@ -6,15 +6,18 @@ both default new pushes there for large images); the pull path used to
 reject them up front in ``registry/client.py``. CPython grows a stdlib
 ``compression.zstd`` only in 3.14, and the sandbox must not pip-install
 anything — but every mainstream distro ships ``libzstd.so.1``, and the
-streaming decode surface (``ZSTD_createDStream`` /
-``ZSTD_decompressStream``) is four calls. This module binds exactly
-that: a read-only file-like decoder with bounded memory (one input +
-one output buffer of libzstd's recommended sizes), which is all the
-layer-application path needs.
+streaming surfaces (``ZSTD_decompressStream`` /
+``ZSTD_compressStream2``) are a handful of calls each. This module
+binds exactly those: a read-only file-like decoder and a write-only
+file-like encoder, both with bounded memory (one input + one output
+buffer of libzstd's recommended sizes), plus one-shot block
+compress/decompress.
 
-No compression side on purpose: layers this builder *writes* stay
-deterministic gzip (cache identity and chunk reconstitution depend on
-it); zstd support is a consume-side compatibility surface.
+The compress side serves the **seekable pack** plane (serve/recipe.py):
+packs are encoded as independently-decompressible frames so ranged
+span fetches decompress without upstream context. LAYERS this builder
+writes stay deterministic gzip — gzip cache identity and chunk
+reconstitution are untouched; zstd output never enters a layer digest.
 """
 
 from __future__ import annotations
@@ -69,10 +72,40 @@ def _load():
             lib.ZSTD_getErrorName.restype = ctypes.c_char_p
             lib.ZSTD_DStreamInSize.restype = ctypes.c_size_t
             lib.ZSTD_DStreamOutSize.restype = ctypes.c_size_t
+            # Compress side (streaming + one-shot). Every libzstd.so.1
+            # since 1.4 exports these; a host whose library somehow
+            # lacks one degrades the whole module to available()==False
+            # rather than failing later mid-write.
+            lib.ZSTD_createCStream.restype = ctypes.c_void_p
+            lib.ZSTD_freeCStream.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_initCStream.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int]
+            lib.ZSTD_initCStream.restype = ctypes.c_size_t
+            lib.ZSTD_compressStream2.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(_OutBuffer),
+                ctypes.POINTER(_InBuffer), ctypes.c_int]
+            lib.ZSTD_compressStream2.restype = ctypes.c_size_t
+            lib.ZSTD_CStreamInSize.restype = ctypes.c_size_t
+            lib.ZSTD_CStreamOutSize.restype = ctypes.c_size_t
+            lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+            lib.ZSTD_compressBound.restype = ctypes.c_size_t
+            lib.ZSTD_compress.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_int]
+            lib.ZSTD_compress.restype = ctypes.c_size_t
+            lib.ZSTD_decompress.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t]
+            lib.ZSTD_decompress.restype = ctypes.c_size_t
             _lib = lib
         except (OSError, AttributeError):
             _lib_failed = True
         return _lib
+
+
+# ZSTD_EndDirective values for ZSTD_compressStream2.
+_ZSTD_E_CONTINUE = 0
+_ZSTD_E_END = 2
 
 
 def available() -> bool:
@@ -207,18 +240,19 @@ class ZstdReader(io.RawIOBase):
         self.close()
 
 
-def compress(data: bytes, level: int = 3) -> bytes:
-    """One-shot compression (tests/fixtures only — the build pipeline
-    never writes zstd; see the module docstring)."""
+# Default compression level for pack frames: zstd's own default; wins
+# most of the ratio at a fraction of the higher levels' CPU — the
+# publish-time cost every indexed layer pays once.
+DEFAULT_LEVEL = 3
+
+
+def compress(data: bytes, level: int = DEFAULT_LEVEL) -> bytes:
+    """One-shot block compression into a single complete zstd frame —
+    the seekable-pack plane's frame encoder (each frame independently
+    decompressible)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("libzstd is not available in this process")
-    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
-    lib.ZSTD_compressBound.restype = ctypes.c_size_t
-    lib.ZSTD_compress.argtypes = [
-        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
-        ctypes.c_size_t, ctypes.c_int]
-    lib.ZSTD_compress.restype = ctypes.c_size_t
     bound = int(lib.ZSTD_compressBound(len(data)))
     dst = ctypes.create_string_buffer(bound)
     rc = lib.ZSTD_compress(ctypes.cast(dst, ctypes.c_void_p), bound,
@@ -228,3 +262,106 @@ def compress(data: bytes, level: int = 3) -> bytes:
             "zstd compress failed: "
             + lib.ZSTD_getErrorName(rc).decode(errors="replace"))
     return dst.raw[:rc]
+
+
+def decompress(data: bytes, expected_size: int) -> bytes:
+    """One-shot frame decompression to exactly ``expected_size`` bytes.
+    Truncated, corrupt, or wrong-sized frames raise ``ValueError`` —
+    the pack-frame consumer treats any of those as a failed span and
+    degrades, never installs short bytes."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("libzstd is not available in this process")
+    dst = ctypes.create_string_buffer(max(expected_size, 1))
+    rc = lib.ZSTD_decompress(ctypes.cast(dst, ctypes.c_void_p),
+                             expected_size, data, len(data))
+    if lib.ZSTD_isError(rc):
+        raise ValueError(
+            "zstd decode failed: "
+            + lib.ZSTD_getErrorName(rc).decode(errors="replace"))
+    if rc != expected_size:
+        raise ValueError(
+            f"zstd frame decoded to {rc} bytes, expected "
+            f"{expected_size}")
+    return dst.raw[:expected_size]
+
+
+class ZstdWriter:
+    """Write-only streaming compressor over an inner file object: the
+    encode mirror of :class:`ZstdReader`. Memory stays bounded by
+    libzstd's recommended buffer pair regardless of stream size;
+    ``close()`` ends the frame (a stream abandoned before close is a
+    truncated frame, which ZstdReader refuses — fail-stop, never a
+    silently short artifact). One frame per writer."""
+
+    def __init__(self, fileobj, level: int = DEFAULT_LEVEL) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "libzstd is not available in this process")
+        self._lib = lib
+        self._fh = fileobj
+        self._stream = lib.ZSTD_createCStream()
+        if not self._stream:
+            raise MemoryError("ZSTD_createCStream failed")
+        self._check(lib.ZSTD_initCStream(self._stream, level))
+        self._out_cap = int(lib.ZSTD_CStreamOutSize())
+        self._out_buf = ctypes.create_string_buffer(self._out_cap)
+        self._closed = False
+        self.compressed_size = 0  # bytes written downstream
+        self.raw_size = 0         # bytes accepted
+
+    def _check(self, rc: int) -> int:
+        if self._lib.ZSTD_isError(rc):
+            raise ValueError(
+                "zstd encode failed: "
+                + self._lib.ZSTD_getErrorName(rc).decode(
+                    errors="replace"))
+        return rc
+
+    def _round(self, inbuf, directive: int) -> int:
+        out = _OutBuffer(ctypes.cast(self._out_buf, ctypes.c_void_p),
+                         self._out_cap, 0)
+        rc = self._check(self._lib.ZSTD_compressStream2(
+            self._stream, ctypes.byref(out), ctypes.byref(inbuf),
+            directive))
+        if out.pos:
+            self._fh.write(ctypes.string_at(self._out_buf, out.pos))
+            self.compressed_size += out.pos
+        return rc
+
+    def write(self, data) -> int:
+        if self._closed:
+            raise ValueError("write to a closed ZstdWriter")
+        data = bytes(data)
+        self.raw_size += len(data)
+        inbuf = _InBuffer(
+            ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p),
+            len(data), 0)
+        while inbuf.pos < inbuf.size:
+            self._round(inbuf, _ZSTD_E_CONTINUE)
+        return len(data)
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        inbuf = _InBuffer(None, 0, 0)
+        while self._round(inbuf, _ZSTD_E_END) != 0:
+            pass
+        self._lib.ZSTD_freeCStream(self._stream)
+        self._stream = None
+
+    def __del__(self) -> None:
+        if getattr(self, "_stream", None):
+            self._lib.ZSTD_freeCStream(self._stream)
+            self._stream = None
+
+    def __enter__(self) -> "ZstdWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

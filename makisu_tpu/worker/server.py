@@ -322,6 +322,13 @@ class _Handler(BaseHTTPRequestHandler):
             serve_server.handle_pack(
                 self, self.path[len("/packs/"):],
                 roots=self.server.served_chunk_roots())
+        elif self.path.startswith("/zpacks/"):
+            # Seekable twin: ranged COMPRESSED frames of the same
+            # packs (404 routes frame-less packs to /packs).
+            from makisu_tpu.serve import server as serve_server
+            serve_server.handle_zpack(
+                self, self.path[len("/zpacks/"):],
+                roots=self.server.served_chunk_roots())
         elif self.path == "/peers":
             from makisu_tpu.fleet import peers as fleet_peers
             self._respond(200, json.dumps({

@@ -136,6 +136,53 @@ uint8_t* pgz_block(const uint8_t* data, size_t n, int level, int last,
   return out;
 }
 
+// Multi-block entry: compress consecutive block_size-sliced segments
+// of `data` SEQUENTIALLY in one call — the GIL is released for the
+// whole batch, so a Python-side worker pool gets C-speed lanes without
+// per-block ctypes/future overhead (the parallelism lives in the
+// caller's lanes, each owning one batch). Framing follows the
+// streaming convention PgzipWriter and layersink.cpp shipped (blob
+// cache identity): a non-final batch must be an exact multiple of
+// block_size (every slice sync-flushed); a final batch additionally
+// emits the tail `n % block_size` bytes — possibly EMPTY — as the
+// Z_FINISH slice. Output bytes are a pure function of (data, level,
+// block_size, last): identical however the stream is batched or laned.
+uint8_t* pgz_blocks(const uint8_t* data, size_t n, int level,
+                    size_t block_size, int last, size_t* out_n) {
+  if (block_size == 0 || level < 0 || level > 9 || out_n == nullptr) {
+    return nullptr;
+  }
+  size_t nfull = n / block_size;
+  if (!last && nfull * block_size != n) {
+    return nullptr;  // non-final batches must be whole blocks
+  }
+  size_t nblocks = last ? nfull + 1 : nfull;
+  if (nblocks == 0) {
+    return nullptr;  // an empty non-final batch is a caller bug
+  }
+  std::vector<std::vector<uint8_t>> outs(nblocks);
+  size_t total = 0;
+  for (size_t i = 0; i < nblocks; ++i) {
+    size_t off = i * block_size;
+    size_t len = (i < nfull) ? block_size : n - off;
+    bool fin = last != 0 && i + 1 == nblocks;
+    if (!makisu_native::DeflateSlice(data + off, len, level, fin,
+                                     outs[i])) {
+      return nullptr;
+    }
+    total += outs[i].size();
+  }
+  uint8_t* out = static_cast<uint8_t*>(::operator new(total, std::nothrow));
+  if (out == nullptr) return nullptr;
+  size_t pos = 0;
+  for (auto& seg : outs) {
+    std::memcpy(out + pos, seg.data(), seg.size());
+    pos += seg.size();
+  }
+  *out_n = pos;
+  return out;
+}
+
 int pgz_abi_version() { return 1; }
 
 }  // extern "C"

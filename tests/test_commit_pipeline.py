@@ -306,6 +306,127 @@ def test_hash_linger_config(monkeypatch):
     assert concurrency.hash_linger_ms() == 7.5
 
 
+@contextlib.contextmanager
+def compress_workers(n):
+    token = concurrency.set_compress_workers(n)
+    try:
+        yield
+    finally:
+        concurrency.reset_compress_workers(token)
+
+
+def test_block_gzip_writer_identical_at_every_worker_count():
+    """The block-parallel compress stage's tentpole invariant: lane
+    count is a THROUGHPUT knob — output bytes are a pure function of
+    (content, level, block size) at workers 1/4/8, and they decompress
+    back to the input."""
+    import gzip as gzip_mod
+    import io
+    rng = np.random.default_rng(33)
+    payload = rng.integers(0, 256, size=3_000_000,
+                           dtype=np.uint8).tobytes()
+    outs = {}
+    for workers in (1, 4, 8):
+        buf = io.BytesIO()
+        w = tario.BlockGzipWriter(buf, level=6, block_size=131072,
+                                  workers=workers)
+        for i in range(0, len(payload), 37_001):  # ragged writes
+            w.write(payload[i:i + 37_001])
+        w.close()
+        outs[workers] = buf.getvalue()
+    assert outs[1] == outs[4] == outs[8]
+    assert gzip_mod.decompress(outs[1]) == payload
+
+
+@pytest.mark.skipif(not native.pgzip_available(),
+                    reason="libpgzip.so not built")
+def test_block_codecs_byte_identical():
+    """The stdlib-zlib codec and the native multi-block entry emit the
+    SAME slice bytes — the equivalence that makes pgzip backend ids
+    replayable on hosts without the native library (cache identity
+    must not depend on which codec ran). Swept over the seams: empty,
+    sub-block, exact block multiples, ragged tails."""
+    if not native.pgz_blocks_available():
+        pytest.skip("libpgzip.so predates the multi-block entry")
+    rng = np.random.default_rng(37)
+    blob = rng.integers(0, 256, size=131072 * 3 + 17,
+                        dtype=np.uint8).tobytes()
+    for n in (0, 1, 5_000, 131072, 131072 * 2, 131072 * 2 + 5,
+              len(blob)):
+        data = blob[:n]
+        assert native.deflate_blocks(data, 6, 131072, True) == \
+            tario._py_deflate_blocks(data, 6, 131072, True), n
+    # Non-final batches (whole blocks only) too.
+    data = blob[:131072 * 2]
+    assert native.deflate_blocks(data, 6, 131072, False) == \
+        tario._py_deflate_blocks(data, 6, 131072, False)
+    # And the writer's stitched stream matches the one-shot native
+    # compressor (the framing contract layersink.cpp shares).
+    import io
+    buf = io.BytesIO()
+    w = tario.BlockGzipWriter(buf, level=6, block_size=131072,
+                              workers=4)
+    w.write(blob)
+    w.close()
+    with io.BytesIO() as legacy:
+        with native.PgzipWriter(legacy, level=6) as lw:
+            lw.write(blob)
+        assert buf.getvalue() == legacy.getvalue()
+
+
+@pytest.mark.skipif(not native.gear_scan_available(),
+                    reason="libgear.so not built")
+@pytest.mark.parametrize("backend_id", ["zlib-6", "pgzip-6-131072"])
+def test_commit_identical_across_compress_worker_counts(tmp_path,
+                                                        backend_id):
+    """Full-sink sweep over the COMPRESS workers knob (the block-
+    parallel deflate stage): digests identical at lanes 1 vs 4 on both
+    backends — zlib's continuous stream by construction, pgzip's block
+    stream by the _BlockBuffer determinism contract."""
+    root = _tree(tmp_path, seed=13)
+    ident = {}
+    for lanes in (1, 4):
+        path = str(tmp_path / f"lanes{lanes}.tar.gz")
+        with compress_workers(lanes):
+            commit = _commit(root, path, backend_id, workers=4)
+        ident[lanes] = _identity(commit, path)
+    assert ident[1] == ident[4]
+
+
+def test_compress_stage_busy_recorded_for_block_writer():
+    """The block-parallel stage feeds the same stage-busy series the
+    report's bottleneck ranking reads (lane tasks self-report)."""
+    import io
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        rng = np.random.default_rng(41)
+        payload = rng.integers(0, 256, size=2_000_000,
+                               dtype=np.uint8).tobytes()
+        w = tario.BlockGzipWriter(io.BytesIO(), level=6,
+                                  block_size=131072, workers=4)
+        w.write(payload)
+        w.close()
+    finally:
+        metrics.reset_build_registry(token)
+    assert reg.counter_total(metrics.COMMIT_STAGE_BUSY,
+                             stage=metrics.COMPRESS_STAGE) > 0
+    assert reg.counter_total(metrics.COMPRESS_BLOCKS,
+                             backend="pgzip") >= 16
+
+
+def test_compress_workers_config(monkeypatch):
+    monkeypatch.setenv("MAKISU_TPU_COMPRESS_WORKERS", "3")
+    assert concurrency.compress_workers() == 3
+    token = concurrency.set_compress_workers(5)
+    assert concurrency.compress_workers() == 5
+    concurrency.reset_compress_workers(token)
+    assert concurrency.compress_workers() == 3
+    monkeypatch.setenv("MAKISU_TPU_COMPRESS_WORKERS", "junk")
+    assert concurrency.compress_workers() == \
+        concurrency.default_compress_workers()
+
+
 def test_gzip_backend_auto_resolves_concrete():
     resolved = tario.resolve_backend("auto")
     assert resolved == ("pgzip" if native.pgzip_available() else "zlib")

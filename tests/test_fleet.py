@@ -362,7 +362,9 @@ def test_peer_chunk_fetch_hits_before_registry(tmp_path, fleet2):
         g.counter_total("makisu_fleet_chunk_serves_total",
                         result="hit")
         + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="range")
-        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full"))
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="zrange")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="zfull"))
     ctx = _make_ctx(tmp_path, "peer-ctx")
     argv = _build_argv(tmp_path, ctx, fleet2.kv_addr)
     assert fleet2.client.build(argv, tenant="t") == 0
@@ -385,7 +387,9 @@ def test_peer_chunk_fetch_hits_before_registry(tmp_path, fleet2):
         g.counter_total("makisu_fleet_chunk_serves_total",
                         result="hit")
         + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="range")
-        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full"))
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="full")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="zrange")
+        + g.counter_total(metrics.SERVE_PACK_REQUESTS, kind="zfull"))
     assert hits > before_hits, "no chunk came from a peer"
     assert serves > before_serves, "no worker served a peer fetch"
     # Byte identity across the relocation.

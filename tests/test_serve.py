@@ -240,6 +240,19 @@ def _payload(seed, size=1_500_000):
         0, 256, size=size, dtype=np.uint8).tobytes()
 
 
+def _text_payload(seed, size=1_500_000):
+    """Compressible-but-chunkable content (log-like lines with random
+    ids): the shape where the seekable-zstd wire actually wins — pure
+    random makes zstd a net loss and the client rightly keeps the raw
+    wire (it prices both from the frame index)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 2 ** 48, size=size // 40 + 1)
+    text = b"".join(b"req %012x served from cache tier A\n" % int(i)
+                    for i in ids)
+    return text[:size]
+
+
 class _Plane:
     """One builder storage + registry fixture + serve socket: the
     publishing side of the distribution plane, build-by-build."""
@@ -357,7 +370,10 @@ def test_corrupt_pack_range_rejected(tmp_path):
     sock = plane.serve()
 
     # Flip a byte in every served chunk ≥ 4KiB (the pack spans will
-    # carve garbage).
+    # carve garbage) AND in every seekable frame file (written at
+    # publish time from the then-healthy CAS, it would otherwise still
+    # serve the original bytes — correct, but not this test's
+    # scenario: a serving store corrupted across the board).
     chunk_dir = os.path.join(plane.storage, "chunks")
     flipped = 0
     for dirpath, _, names in os.walk(chunk_dir):
@@ -373,6 +389,14 @@ def test_corrupt_pack_range_rejected(tmp_path):
                 f.write(bytes([byte[0] ^ 0xFF]))
             flipped += 1
     assert flipped, "expected chunk files to corrupt"
+    zpack_dir = os.path.join(plane.storage, "serve", "zpacks")
+    for fname in os.listdir(zpack_dir):
+        path = os.path.join(zpack_dir, fname)
+        with open(path, "r+b") as f:
+            f.seek(50)
+            byte = f.read(1)
+            f.seek(50)
+            f.write(bytes([byte[0] ^ 0xFF]))
 
     cstore, creg = plane.puller()
     n1 = ImageName("registry.test", "t/app", "v1")
@@ -441,6 +465,230 @@ def test_serve_pack_endpoint_range_semantics(tmp_path):
     assert status == 400
 
 
+# -- seekable-zstd packs ------------------------------------------------------
+
+
+def _zstd_required():
+    from makisu_tpu.utils import zstdio
+    if not zstdio.available():
+        pytest.skip("libzstd not available on this host")
+    return zstdio
+
+
+def test_seekable_frame_index_roundtrip(tmp_path):
+    """Publish writes the compressed twin + frame index: frames are
+    whole-chunk groups, decompress independently, and concatenate back
+    to the exact raw pack bytes; a FRESH store (new process) re-loads
+    the dict-form pack table with its frames."""
+    import hashlib
+    zstdio = _zstd_required()
+    from makisu_tpu.cache.chunks import ChunkStore
+    from makisu_tpu.docker.image import (
+        MEDIA_TYPE_LAYER, Descriptor, Digest, DigestPair)
+    store = ChunkStore(str(tmp_path / "chunks"))
+    rs = recipe_mod.RecipeStore(str(tmp_path / "serve"),
+                                str(tmp_path / "chunks"))
+    rng_chunks = [os.urandom(50_000) for _ in range(10)]
+    triples, off = [], 0
+    for data in rng_chunks:
+        fp = hashlib.sha256(data).hexdigest()
+        store.put(fp, data)
+        triples.append((off, len(data), fp))
+        off += len(data)
+    pair = DigestPair(
+        tar_digest=Digest.from_hex("12" * 32),
+        gzip_descriptor=Descriptor(MEDIA_TYPE_LAYER, off,
+                                   Digest.from_hex("34" * 32)))
+    doc = rs.publish(pair, triples, None, store)
+    assert doc is not None and recipe_mod.verify(doc, key=b"")
+    (pack_hex,) = {row[2] for row in doc["chunks"]}
+    frames = doc["zpacks"][pack_hex]
+    assert frames and recipe_mod._frame_rows_valid(frames)
+    raw = b"".join(rng_chunks)
+    # Frames tile the raw pack exactly and decompress independently.
+    assert frames[0][0] == 0
+    assert sum(r[1] for r in frames) == len(raw)
+    zpath = os.path.join(str(tmp_path / "serve"), "zpacks",
+                         f"{pack_hex}.zst")
+    zblob = open(zpath, "rb").read()
+    assert len(zblob) == frames[-1][2] + frames[-1][3]
+    rebuilt = b"".join(
+        zstdio.decompress(zblob[z_off:z_off + z_len], raw_len)
+        for _, raw_len, z_off, z_len in
+        ((r[0], r[1], r[2], r[3]) for r in frames))
+    assert rebuilt == raw
+    # A fresh store (another process) parses the dict-form table.
+    rs2 = recipe_mod.RecipeStore(str(tmp_path / "serve"),
+                                 str(tmp_path / "chunks"))
+    assert rs2.pack_frames(pack_hex) == [
+        [int(v) for v in row] for row in frames]
+    assert rs2.zpack_size(pack_hex) == len(zblob)
+
+
+def test_malformed_frame_index_demotes_to_raw_serving(tmp_path):
+    """A pack table whose frame rows are garbage (non-int, wrong
+    shape) must keep serving its intact member table raw — the frames
+    are an optimization, never allowed to 404 the pack."""
+    os.makedirs(tmp_path / "serve" / "packs", exist_ok=True)
+    pack_hex = "ab" * 32
+    with open(tmp_path / "serve" / "packs" / f"{pack_hex}.json",
+              "w") as f:
+        json.dump({"members": [["cd" * 32, 100]],
+                   "frames": [["x", 1, 2, 3]]}, f)
+    rs = recipe_mod.RecipeStore(str(tmp_path / "serve"),
+                                str(tmp_path / "chunks"))
+    assert rs.pack_members(pack_hex) == [("cd" * 32, 100)]
+    assert rs.pack_frames(pack_hex) is None
+    assert rs.zpack_size(pack_hex) == 0
+
+
+def test_plan_frame_runs_maps_spans_to_frames():
+    from makisu_tpu.cache.chunks import plan_frame_runs
+    # 4 frames of 100 raw bytes; compressed 40 each at z offsets 0..160.
+    frames = [[0, 100, 0, 40], [100, 100, 40, 40],
+              [200, 100, 80, 40], [300, 100, 120, 40]]
+    # A span inside frame 0 and one crossing frames 2→3: frame 1 is
+    # not needed, so its 40 compressed bytes split the plan into two
+    # runs at gap=0 — and a crossing span names BOTH its frames.
+    runs = plan_frame_runs(frames, [(20, 10, "f1"), (290, 20, "f2")],
+                           gap=0)
+    assert runs == [[[0, 100, 0, 40]],
+                    [[200, 100, 80, 40], [300, 100, 120, 40]]]
+    # With a generous gap the two runs coalesce into one request:
+    # frame 1's bytes are over-fetched inside the range but stay out
+    # of the run's rows (never decompressed — only needed frames are).
+    runs = plan_frame_runs(frames, [(20, 10, "f1"), (290, 20, "f2")],
+                           gap=1000)
+    assert len(runs) == 1 and len(runs[0]) == 3
+    assert [r[0] for r in runs[0]] == [0, 200, 300]
+    # Needed frames that are z-adjacent always share a run.
+    runs = plan_frame_runs(frames, [(120, 10, "f1"), (290, 20, "f2")],
+                           gap=0)
+    assert len(runs) == 1 and len(runs[0]) == 3
+
+
+def test_serve_zpack_endpoint_ranged_mid_pack_frame(tmp_path):
+    """Wire-level /zpacks: a mid-pack frame fetched by compressed
+    Range decompresses to exactly that frame's raw bytes; 416 past the
+    end; 404 for frame-less hexes."""
+    zstdio = _zstd_required()
+    plane = _Plane(tmp_path)
+    manifest = plane.build_and_push("v1", _payload(29))
+    sock = plane.serve()
+    store = serve_server_mod.store_for(plane.storage)
+    doc = store.recipe(manifest.layers[0].digest.hex())
+    pack_hex = doc["chunks"][0][2]
+    frames = store.pack_frames(pack_hex)
+    assert frames and len(frames) >= 3, "expected a multi-frame pack"
+    mid = frames[len(frames) // 2]
+    raw_off, raw_len, z_off, z_len = mid
+    client = ServeClient(sock)
+    kind, body = client.zpack_range(pack_hex, z_off, z_off + z_len)
+    assert kind == "partial" and len(body) == z_len
+    rawbuf = zstdio.decompress(body, raw_len)
+    # The decompressed frame equals the raw pack's same span.
+    kind, rawspan = client.pack_range(pack_hex, raw_off,
+                                      raw_off + raw_len)
+    assert kind == "partial" and rawbuf == rawspan
+    zsize = store.zpack_size(pack_hex)
+    status, _, _ = client._get(
+        f"/zpacks/{pack_hex}", headers={"Range": f"bytes={zsize}-"})
+    assert status == 416
+    status, _, _ = client._get(f"/zpacks/{'0' * 64}")
+    assert status == 404
+    status, _, _ = client._get("/zpacks/not-a-digest")
+    assert status == 400
+
+
+def test_delta_pull_rides_compressed_wire(tmp_path):
+    """The seekable acceptance: a 1-edit delta pull moves FEWER wire
+    bytes than the raw-pack plan would have (bytes_fetched <=
+    bytes_raw_wire, with zstd requests actually on the wire), digests
+    byte-identical."""
+    _zstd_required()
+    g = metrics.global_registry()
+    before_z = (g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                kind="zrange")
+                + g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                  kind="zfull"))
+    plane = _Plane(tmp_path)
+    v1 = _text_payload(31)
+    v2 = v1[:9_000] + b"EDIT" + v1[9_000:]
+    plane.build_and_push("v1", v1)
+    sock = plane.serve()
+    cstore, creg = plane.puller()
+    pull_image_delta(creg, cstore,
+                     ImageName("registry.test", "t/app", "v1"), sock)
+    plane.build_and_push("v2", v2)
+    n2 = ImageName("registry.test", "t/app", "v2")
+    _, rep = pull_image_delta(creg, cstore, n2, sock)
+    assert rep["delta_layers"] >= 1, rep
+    assert rep["bytes_fetched"] < rep["bytes_raw_wire"], rep
+    z_requests = (g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                  kind="zrange")
+                  + g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                    kind="zfull")) - before_z
+    assert z_requests >= 1, "delta never touched the compressed wire"
+    # Byte identity vs a cold full pull.
+    ostore, oreg = plane.puller("oracle")
+    om = oreg.pull(n2)
+    for desc in om.layers:
+        hx = desc.digest.hex()
+        with ostore.layers.open(hx) as fa, cstore.layers.open(hx) as fb:
+            assert fa.read() == fb.read()
+
+
+def test_old_client_keeps_raw_pack_wire(tmp_path, monkeypatch):
+    """Capability negotiation, client side: a puller without zstd (old
+    binary, no libzstd) must ride the raw /packs wire end to end —
+    same bytes installed, zero /zpacks requests."""
+    from makisu_tpu.utils import zstdio
+    plane = _Plane(tmp_path)
+    plane.build_and_push("v1", _payload(37))
+    sock = plane.serve()
+    g = metrics.global_registry()
+    before_z = g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                               kind="zrange")
+    monkeypatch.setattr(zstdio, "available", lambda: False)
+    cstore, creg = plane.puller()
+    n1 = ImageName("registry.test", "t/app", "v1")
+    _, rep = pull_image_delta(creg, cstore, n1, sock)
+    assert rep["delta_layers"] >= 1, rep
+    assert rep["bytes_fetched"] == rep["bytes_raw_wire"], rep
+    assert g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                           kind="zrange") == before_z
+    for desc in creg.pull_manifest("v1").layers:
+        assert cstore.layers.exists(desc.digest.hex())
+
+
+def test_lying_frame_never_installs(tmp_path):
+    """A corrupted/lying frame file: decompression fails or carved
+    chunks fail sha256 — either way nothing corrupt installs; the raw
+    pack wire (or blob route) produces the correct bytes."""
+    import hashlib
+    _zstd_required()
+    plane = _Plane(tmp_path)
+    plane.build_and_push("v1", _text_payload(41))
+    # Corrupt every seekable frame file; leave the chunk CAS healthy.
+    zpack_dir = os.path.join(plane.storage, "serve", "zpacks")
+    for fname in os.listdir(zpack_dir):
+        path = os.path.join(zpack_dir, fname)
+        blob = bytearray(open(path, "rb").read())
+        for i in range(0, len(blob), 97):
+            blob[i] ^= 0xA5
+        open(path, "wb").write(bytes(blob))
+    sock = plane.serve()
+    cstore, creg = plane.puller()
+    n1 = ImageName("registry.test", "t/app", "v1")
+    _, rep = pull_image_delta(creg, cstore, n1, sock)
+    # The pull still lands (raw wire fallback) and installs only
+    # registry-digest-verified bytes.
+    for desc in creg.pull_manifest("v1").layers:
+        hx = desc.digest.hex()
+        with cstore.layers.open(hx) as f:
+            assert hashlib.sha256(f.read()).hexdigest() == hx
+
+
 # -- fleet peer plane on the pack wire ---------------------------------------
 
 
@@ -467,6 +715,10 @@ def test_fleet_peer_exchange_is_pack_granular(tmp_path):
                                       kind="range"),
         "pack_full": g.counter_total(metrics.SERVE_PACK_REQUESTS,
                                      kind="full"),
+        "pack_zrange": g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                       kind="zrange"),
+        "pack_zfull": g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                      kind="zfull"),
     }
     fleet = _Fleet(tmp_path, n=2)
     try:
@@ -494,7 +746,12 @@ def test_fleet_peer_exchange_is_pack_granular(tmp_path):
                                   kind="range")
                   + g.counter_total(metrics.SERVE_PACK_REQUESTS,
                                     kind="full")
-                  - before["pack_range"] - before["pack_full"])
+                  + g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                    kind="zrange")
+                  + g.counter_total(metrics.SERVE_PACK_REQUESTS,
+                                    kind="zfull")
+                  - before["pack_range"] - before["pack_full"]
+                  - before["pack_zrange"] - before["pack_zfull"])
         per_chunk = g.counter_total(
             "makisu_fleet_chunk_serves_total",
             result="hit") - before["chunk_serves"]

@@ -98,6 +98,68 @@ def test_gzip_reader_sniffs_zstd(tmp_path):
                 assert stream.read() == tar_bytes
 
 
+# -- the writer / compress side ----------------------------------------------
+
+
+def test_zstd_writer_streaming_roundtrip():
+    """ZstdWriter (the encode mirror): ragged writes, one frame,
+    decodable by ZstdReader and by one-shot decompress."""
+    payload = bytes(range(256)) * 3000
+    out = io.BytesIO()
+    with zstdio.ZstdWriter(out) as w:
+        for i in range(0, len(payload), 7919):
+            w.write(payload[i:i + 7919])
+    blob = out.getvalue()
+    assert zstdio.is_zstd(blob)
+    assert w.raw_size == len(payload)
+    assert w.compressed_size == len(blob)
+    assert zstdio.ZstdReader(io.BytesIO(blob)).read() == payload
+    assert zstdio.decompress(blob, len(payload)) == payload
+
+
+def test_zstd_writer_empty_stream():
+    out = io.BytesIO()
+    with zstdio.ZstdWriter(out) as w:
+        pass
+    assert zstdio.ZstdReader(io.BytesIO(out.getvalue())).read() == b""
+    with pytest.raises(ValueError):
+        w.write(b"late")  # closed writer refuses
+
+
+def test_zstd_oneshot_roundtrip_and_errors():
+    payload = b"frame-content " * 10_000
+    blob = zstdio.compress(payload, level=3)
+    assert zstdio.decompress(blob, len(payload)) == payload
+    # Wrong expected size: fail-stop, never short bytes.
+    with pytest.raises(ValueError):
+        zstdio.decompress(blob, len(payload) - 1)
+    # Truncated frame raises.
+    with pytest.raises(ValueError):
+        zstdio.decompress(blob[:len(blob) // 2], len(payload))
+    # Corrupt frame header raises.
+    bad = bytearray(blob)
+    bad[4] ^= 0xFF
+    with pytest.raises(ValueError):
+        zstdio.decompress(bytes(bad), len(payload))
+
+
+def test_zstd_abandoned_writer_stream_is_refused():
+    """A stream abandoned before close() is a truncated frame — the
+    reader must refuse it rather than silently hand back a prefix."""
+    import os as os_mod
+    out = io.BytesIO()
+    w = zstdio.ZstdWriter(out)
+    # Incompressible input so the encoder must flush mid-stream (a
+    # tiny compressible write can sit in zstd's internal block buffer
+    # until close, leaving nothing torn to observe).
+    w.write(os_mod.urandom(1_000_000))
+    torn = out.getvalue()
+    assert torn, "encoder should have flushed mid-stream"
+    with pytest.raises(ValueError, match="truncated"):
+        zstdio.ZstdReader(io.BytesIO(torn)).read()
+    w.close()
+
+
 # -- pull + FROM --------------------------------------------------------------
 
 
