@@ -409,11 +409,25 @@ def make_parser() -> argparse.ArgumentParser:
         "report", help="critical-path analysis of a telemetry report")
     report.add_argument("metrics_file",
                         help="a --metrics-out JSON report OR a "
-                             "diagnostic bundle (--diag-out) to analyze")
+                             "diagnostic bundle (--diag-out) to "
+                             "analyze; with --fleet, a merged events "
+                             "JSONL (the fleet front door's "
+                             "--events-out) instead")
     report.add_argument("--events", default="", metavar="FILE",
                         help="an --events-out JSONL log to include "
                              "(torn final lines of killed builds are "
                              "salvaged)")
+    report.add_argument("--fleet", action="store_true",
+                        help="cross-process fleet analysis: treat the "
+                             "input as a merged event log (front-door "
+                             "spans + teed worker events), assemble "
+                             "one span tree per trace id across "
+                             "processes, and render the cross-process "
+                             "critical path (front-door quota wait vs "
+                             "worker queue wait vs build phases, "
+                             "failover attempts as sibling subtrees); "
+                             "the top-level --trace-out writes the "
+                             "merged Perfetto export")
 
     explain = sub.add_parser(
         "explain", help="chunk-level cache miss attribution from a "
@@ -473,6 +487,13 @@ def make_parser() -> argparse.ArgumentParser:
                              "healthy window (default ledger: "
                              "$MAKISU_TPU_DEVICE_SESSIONS_DIR or "
                              "benchmarks/device_sessions)")
+    doctor.add_argument("--fleet", action="store_true",
+                        help="cross-worker fleet diagnosis: poll the "
+                             "front door's /healthz at the given "
+                             "SOCKET and name dead/draining workers, "
+                             "stale peer-map acks, tenants pinned at "
+                             "their quota, and placement-memo drift "
+                             "vs actual session residency")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -978,6 +999,33 @@ def cmd_report(args) -> int:
     from makisu_tpu.utils import events as events_mod
     from makisu_tpu.utils import flightrecorder, traceexport
 
+    if args.fleet:
+        # Cross-process mode: the input is a merged event log — the
+        # fleet front door's --events-out (its own spans + the teed
+        # worker build events). Torn logs salvage like everywhere.
+        try:
+            event_log = events_mod.read_jsonl(args.metrics_file)
+        except ValueError as e:
+            log.warning("%s; analyzing the valid lines only", e)
+            event_log = events_mod.read_jsonl(args.metrics_file,
+                                              skip_invalid=True)
+        assembled = traceexport.assemble_fleet_trace(event_log)
+        if not assembled["traces"]:
+            raise SystemExit(
+                f"{args.metrics_file}: no span events to assemble "
+                f"(expected a fleet --events-out log with "
+                f"span_start/span_end lines)")
+        print(traceexport.render_fleet_report(assembled), end="")
+        if args.trace_out:
+            metrics.write_json_atomic(
+                args.trace_out,
+                traceexport.fleet_perfetto_trace(assembled))
+            log.info("merged fleet trace written to %s",
+                     args.trace_out)
+            # cli.main's generic trace write would clobber the merged
+            # export with this report invocation's (empty) span tree.
+            args.trace_out = ""
+        return 0
     with open(args.metrics_file, encoding="utf-8") as f:
         report = json_mod.load(f)
     capture_ts = None
@@ -1064,6 +1112,28 @@ def cmd_doctor(args) -> int:
 
     from makisu_tpu.utils import flightrecorder
 
+    if getattr(args, "fleet", False):
+        from makisu_tpu.fleet import doctor as fleet_doctor
+        from makisu_tpu.worker import WorkerClient
+        if not args.bundle:
+            raise SystemExit(
+                "doctor --fleet needs the front door's socket path: "
+                "`makisu-tpu doctor --fleet SOCKET`")
+        client = WorkerClient(args.bundle)
+        try:
+            health = client.healthz()
+        except (OSError, RuntimeError, ValueError) as e:
+            raise SystemExit(
+                f"fleet front door on {args.bundle} not reachable: "
+                f"{e}")
+        if "fleet" not in health:
+            raise SystemExit(
+                f"{args.bundle} answers /healthz but carries no "
+                f"fleet section — is it a worker socket? point "
+                f"doctor --fleet at the `makisu-tpu fleet` socket")
+        print(fleet_doctor.render_fleet_doctor(health, args.bundle),
+              end="")
+        return 0
     if args.device:
         from makisu_tpu.utils import deviceprobe
         records = deviceprobe.read_records(args.bundle or None)
@@ -1208,25 +1278,37 @@ def cmd_fleet(args) -> int:
     least-loaded spillover, enforcing per-tenant quotas, failing over
     past dead/refusing workers, and publishing the peer map workers
     use to fetch chunks from each other before the registry."""
-    import contextvars
-
     from makisu_tpu.fleet import FleetServer, WorkerSpec
+    from makisu_tpu.utils import flightrecorder
+    from makisu_tpu.utils import metrics as metrics_mod
     if not args.worker:
         raise SystemExit("fleet needs at least one "
                          "--worker SOCKET[=STORAGE]")
     specs = [WorkerSpec.parse(flag, i)
              for i, flag in enumerate(args.worker)]
+    # The front door's own events — routing spans, decisions, teed
+    # worker build events — happen on handler/poll threads that carry
+    # NO bound context, so the --events-out/--explain-out sinks
+    # cli.main bound in THIS context are promoted process-wide for the
+    # server's lifetime. (Promotion replaces the old event_context
+    # replay: one delivery path, no double-writes.)
+    promoted = events.promote_context_sinks()
     server = FleetServer(
         args.socket, specs,
         poll_interval=args.poll_interval,
         tenant_quota=args.tenant_quota,
         max_inflight=args.max_inflight_builds,
         spillover_queue_depth=args.spillover_queue_depth,
-        # Scheduler decisions (source=fleet) reach THIS invocation's
-        # --events-out/--explain-out sinks: handler threads have no
-        # bound context, so the scheduler replays emissions under the
-        # context captured here.
-        event_context=contextvars.copy_context())
+        stall_window=(args.stall_timeout or None),
+        diag_out=args.diag_out)
+    # Process-level signal forensics, at parity with cmd_worker: a
+    # SIGTERM'd front door dumps a bundle covering every in-flight
+    # routed build (the server's recorder sees all contexts via the
+    # global sink; the GLOBAL registry keeps every build's open route/
+    # forward spans in it), and SIGUSR1 dumps one live WITHOUT
+    # interrupting the in-flight builds.
+    flightrecorder.install_signal_dumps(
+        server.recorder, metrics_mod.global_registry(), args.diag_out)
     log.info("fleet front door listening on %s (%d workers: %s)",
              args.socket, len(specs),
              ", ".join(s.socket_path for s in specs))
@@ -1235,7 +1317,41 @@ def cmd_fleet(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # Pull every worker's serve access ledger BEFORE the sinks
+        # demote: in a real multi-process fleet those rows (the
+        # bytes-on-wire of peer/delta fetches, trace-id-stamped) live
+        # only in the workers — delivering them here lands them in the
+        # promoted --events-out file AND the merged-trace collector.
+        # In-process fleets see them twice; the assembler dedupes.
+        try:
+            for access_event in server.collect_serve_access():
+                events.deliver(access_event)
+        except Exception as e:  # noqa: BLE001 - shutdown must proceed
+            log.warning("serve-access collection failed: %s", e)
+        trace_events = server.trace_events()
         server.server_close()
+        events.demote_sinks(promoted)
+        if args.trace_out:
+            # The merged cross-process trace: the front door's own
+            # spans plus every teed worker event, assembled per trace
+            # id into one Perfetto export. Written here — and the flag
+            # cleared — because cli.main's generic trace write only
+            # sees the (empty) invocation registry, not the per-build
+            # ones routing used.
+            from makisu_tpu.utils import traceexport
+            try:
+                assembled = traceexport.assemble_fleet_trace(
+                    trace_events)
+                metrics.write_json_atomic(
+                    args.trace_out,
+                    traceexport.fleet_perfetto_trace(assembled))
+                log.info("merged fleet trace written to %s "
+                         "(%d trace(s), %d span(s))", args.trace_out,
+                         len(assembled.get("traces", [])),
+                         assembled.get("span_count", 0))
+            except (OSError, ValueError) as e:
+                log.error("failed to write merged fleet trace: %s", e)
+            args.trace_out = ""
     return 0
 
 
@@ -1348,6 +1464,13 @@ def main(argv: list[str] | None = None) -> int:
     # builds in one worker never mix span trees or counters, while the
     # process-global registry (the worker's /metrics) still aggregates.
     registry = metrics.MetricsRegistry()
+    # Trace adoption: when an upstream caller handed this invocation a
+    # trace context (the worker binds the /build request's traceparent;
+    # the fleet forwarder sends its forward span's), the fresh registry
+    # JOINS that trace — same trace id, root span id = the caller's
+    # span — so front door → worker → peer fetch all tell one causal
+    # story. A malformed value mints fresh ids (counted, never fatal).
+    metrics.adopt_inbound(registry, metrics.inbound_traceparent())
     metrics_token = metrics.set_build_registry(registry)
     # Deploy-identity info gauge: constant 1, identity in the labels
     # (the node_exporter "build_info" idiom). Scrapers join it against

@@ -1,0 +1,190 @@
+"""``makisu-tpu doctor --fleet SOCKET``: cross-worker diagnosis.
+
+The per-process forensics (``doctor BUNDLE``, ``doctor --device``)
+explain one process. A fleet fails in the seams BETWEEN processes:
+a worker the scheduler believes dead, a peer map a restarted worker
+silently lost, a tenant pinned at its quota while the fleet idles,
+a sticky placement pointing at a worker whose session evaporated.
+This module reads the front door's ``/healthz`` (fleet + self
+sections) and renders those seams as a diagnosis — pure functions of
+the payload, so tests feed canned snapshots.
+"""
+
+from __future__ import annotations
+
+
+def diagnose_fleet(health: dict) -> list[dict]:
+    """Structured findings from a fleet front door's ``/healthz``
+    payload. Each finding: ``{"severity": "error"|"warning"|"info",
+    "kind": ..., "detail": ...}``, most severe first."""
+    findings: list[dict] = []
+    fleet = health.get("fleet") or {}
+    self_section = health.get("self") or {}
+    workers = fleet.get("workers") or []
+    alive = [w for w in workers if w.get("alive")]
+
+    # 1. Dead workers: the scheduler routes around them, but an
+    # operator must know capacity is gone (and why the poll failed).
+    for w in workers:
+        if not w.get("alive"):
+            age = w.get("last_poll_age_seconds")
+            findings.append({
+                "severity": "error",
+                "kind": "dead_worker",
+                "worker": w.get("id", "?"),
+                "detail": f"worker {w.get('id', '?')} is DEAD "
+                          f"({w.get('last_error') or 'no poll yet'}; "
+                          f"last poll "
+                          f"{age if age is not None else '?'}s ago, "
+                          f"{w.get('consecutive_failures', 0)} "
+                          f"consecutive failures) — capacity lost, "
+                          f"its resident sessions will rebuild "
+                          f"elsewhere cold",
+            })
+    # 2. Draining workers: deliberate, but worth naming (drain that
+    # never concludes is an operator leak).
+    for w in workers:
+        if w.get("alive") and w.get("draining"):
+            findings.append({
+                "severity": "info",
+                "kind": "draining_worker",
+                "worker": w.get("id", "?"),
+                "detail": f"worker {w.get('id', '?')} is draining "
+                          f"({w.get('active_builds', 0)} builds "
+                          f"still in flight; serving peer fetches)",
+            })
+    # 3. Stale peer maps: a worker holding (or acked at) a version
+    # behind the scheduler's current one fetches chunks from a stale
+    # membership — dead peers cost timeouts, new peers go unused.
+    peer_map = self_section.get("peer_map") or {}
+    version = peer_map.get("version",
+                           fleet.get("peer_map_version", 0))
+    acked = peer_map.get("acked") or {}
+    for w in alive:
+        wid = w.get("id", "?")
+        held = acked.get(wid)
+        if held is not None and held < version:
+            findings.append({
+                "severity": "warning",
+                "kind": "stale_peer_map",
+                "worker": wid,
+                "detail": f"worker {wid} last acked peer map "
+                          f"v{held} but the scheduler is at "
+                          f"v{version} — its chunk exchange runs on "
+                          f"stale membership until the next publish "
+                          f"lands",
+            })
+    # 4. Quota starvation: a tenant pinned at its cap while builds
+    # queue at the front door — the quota is doing its job, but a
+    # persistently pinned tenant is a sizing signal.
+    quota = int(fleet.get("tenant_quota", 0) or 0)
+    waiting = int(fleet.get("frontdoor_waiting", 0) or 0)
+    if quota > 0:
+        for tenant, row in sorted((fleet.get("tenants")
+                                   or {}).items()):
+            if int(row.get("inflight", 0)) >= quota:
+                findings.append({
+                    "severity": "warning" if waiting else "info",
+                    "kind": "quota_pinned",
+                    "tenant": tenant,
+                    "detail": f"tenant {tenant} is pinned at its "
+                              f"quota ({row.get('inflight')}/{quota} "
+                              f"in flight"
+                              + (f"; {waiting} build(s) waiting at "
+                                 f"the front door" if waiting
+                                 else "") + ")",
+                })
+    # 5. Placement-memo drift: the sticky memo says a context lives
+    # on worker X, but no alive worker — or a DIFFERENT one — reports
+    # the resident session. Routing still works (the memo re-places),
+    # but warm state is not where the scheduler thinks it is.
+    sessions_of = {w.get("id"): set(w.get("sessions") or [])
+                   for w in alive}
+    for context, wid in sorted((fleet.get("placements")
+                                or {}).items()):
+        holders = sorted(w for w, sess in sessions_of.items()
+                         if context in sess)
+        if wid not in sessions_of:
+            findings.append({
+                "severity": "warning",
+                "kind": "placement_drift",
+                "worker": wid,
+                "detail": f"placement memo pins {context} to "
+                          f"{wid}, which is not alive"
+                          + (f" (session actually resident on "
+                             f"{', '.join(holders)})" if holders
+                             else " (no resident session anywhere — "
+                                  "next build is cold)"),
+            })
+        elif holders and wid not in holders:
+            findings.append({
+                "severity": "info",
+                "kind": "placement_drift",
+                "worker": wid,
+                "detail": f"placement memo pins {context} to {wid} "
+                          f"but the resident session is on "
+                          f"{', '.join(holders)} — next build pays "
+                          f"a relocation",
+            })
+    severity_rank = {"error": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: severity_rank.get(f["severity"], 3))
+    return findings
+
+
+def render_fleet_doctor(health: dict, socket_path: str = "") -> str:
+    """The human rendering: front-door vitals, the per-worker table,
+    then the diagnosis."""
+    fleet = health.get("fleet") or {}
+    self_section = health.get("self") or {}
+    workers = fleet.get("workers") or []
+    lines = [
+        "makisu-tpu fleet doctor"
+        + (f" — {socket_path}" if socket_path else ""),
+        f"front door: status {health.get('status', '?')}   "
+        f"uptime {health.get('uptime_seconds', 0.0):.0f}s   "
+        f"active {health.get('active_builds', 0)}   "
+        f"queued {fleet.get('frontdoor_waiting', 0)}   "
+        f"last progress "
+        f"{health.get('last_progress_seconds', 0.0):.1f}s ago",
+    ]
+    peer_map = self_section.get("peer_map") or {}
+    ring = self_section.get("decision_ring") or {}
+    if self_section:
+        oldest = self_section.get("oldest_poll_age_seconds")
+        lines.append(
+            f"self: poll every "
+            f"{self_section.get('poll_interval_seconds', '?')}s "
+            f"(oldest poll "
+            f"{oldest if oldest is not None else '?'}s)   "
+            f"peer map v{peer_map.get('version', '?')} "
+            f"({len(peer_map.get('stale_acks') or [])} stale ack(s))"
+            f"   decisions rung {ring.get('size', 0)} "
+            + " ".join(f"{k}={v}" for k, v in sorted(
+                (ring.get('verdicts') or {}).items()))
+            + f"   watchdog "
+            + ("armed" if self_section.get("watchdog_armed")
+               else "off"))
+    lines.append("")
+    lines.append(f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} "
+                 f"{'QUEUE':>6s} {'SESS':>5s} {'PEERMAP':>8s}  "
+                 f"LAST ERROR")
+    acked = peer_map.get("acked") or {}
+    for w in workers:
+        wid = w.get("id", "?")
+        held = acked.get(wid)
+        lines.append(
+            f"{wid:<8s} {w.get('state', '?'):<9s} "
+            f"{w.get('active_builds', 0):>6d} "
+            f"{w.get('queue_depth', 0):>6d} "
+            f"{len(w.get('sessions') or []):>5d} "
+            f"{('v' + str(held)) if held is not None else '-':>8s}  "
+            f"{w.get('last_error') or '-'}")
+    findings = diagnose_fleet(health)
+    lines.append("")
+    if not findings:
+        lines.append("diagnosis: fleet healthy — no findings")
+    else:
+        lines.append(f"diagnosis ({len(findings)} finding(s)):")
+        for f in findings:
+            lines.append(f"  [{f['severity']:<7s}] {f['detail']}")
+    return "\n".join(lines) + "\n"

@@ -156,7 +156,10 @@ def fetch_chunk(hex_digest: str) -> bytes | None:
         conn = _UnixHTTPConnection(peer, PEER_TIMEOUT,
                                    connect_timeout=PEER_TIMEOUT)
         try:
-            conn.request("GET", f"/chunks/{hex_digest}")
+            # The fetching build's trace context rides along so the
+            # serving worker's access ledger names this build's trace.
+            conn.request("GET", f"/chunks/{hex_digest}", headers={
+                "traceparent": metrics.current_traceparent()})
             resp = conn.getresponse()
             data = resp.read()
             if resp.status != 200:

@@ -29,7 +29,6 @@ registry (``fleet/peers.py``).
 from __future__ import annotations
 
 import collections
-import contextvars
 import hashlib
 import json
 import threading
@@ -232,9 +231,7 @@ class FleetScheduler:
                  poll_interval: float = 1.0,
                  tenant_quota: int = 0,
                  max_inflight: int = 0,
-                 spillover_queue_depth: int = 2,
-                 event_context: "contextvars.Context | None" = None,
-                 ) -> None:
+                 spillover_queue_depth: int = 2) -> None:
         if not specs:
             raise ValueError("a fleet needs at least one worker")
         self._mu = threading.Lock()
@@ -259,13 +256,6 @@ class FleetScheduler:
         self._tenant_budgets: dict[str, _SlotGate] = {}
         self._tenant_labels: set[str] = set()
         self._frontdoor_waiting = 0
-        # Decision ledger context: ledger.record consults contextvars,
-        # and handler/poll threads have none — run emissions under the
-        # context captured at startup (the `makisu-tpu fleet`
-        # invocation's own, where --events-out/--explain-out sinks are
-        # bound). Serialized: a Context cannot be entered concurrently.
-        self._event_ctx = event_context
-        self._event_ctx_mu = threading.Lock()
         self._peer_version = 0
         self._peer_posted: dict[str, int] = {}
         self._poll_halt = threading.Event()
@@ -677,6 +667,7 @@ class FleetScheduler:
             placements = dict(self._placements)
             waiting = self._frontdoor_waiting
             peer_version = self._peer_version
+            peer_acked = dict(self._peer_posted)
         return {
             "workers": workers,
             "tenant_quota": self.tenant_quota,
@@ -684,6 +675,10 @@ class FleetScheduler:
             "placements": placements,
             "frontdoor_waiting": waiting,
             "peer_map_version": peer_version,
+            # Which peer-map version each worker last acknowledged —
+            # the fan-out the /healthz self section and `doctor
+            # --fleet` read to spot a worker stuck on a stale map.
+            "peer_acked": peer_acked,
             "route_totals": {
                 verdict: int(n) for verdict, n in sorted(
                     g.counter_by_label(FLEET_ROUTE_TOTAL,
@@ -709,17 +704,8 @@ class FleetScheduler:
             record["worker"] = worker
         if tenant:
             record["tenant"] = tenant
-        if self._event_ctx is not None:
-            # ledger.record reads contextvar-bound sinks; handler and
-            # poll threads have none, so run under the invocation
-            # context captured at startup (serialized — a Context
-            # cannot be entered twice concurrently).
-            with self._event_ctx_mu:
-                try:
-                    self._event_ctx.run(ledger.record, "fleet", key,
-                                        verdict, reason, **record)
-                except RuntimeError:
-                    ledger.record("fleet", key, verdict, reason,
-                                  **record)
-        else:
-            ledger.record("fleet", key, verdict, reason, **record)
+        # Handler/poll threads carry no bound context; the decision
+        # reaches --events-out/--explain-out because `makisu-tpu
+        # fleet` promotes the invocation's sinks process-wide
+        # (events.promote_context_sinks in cmd_fleet).
+        ledger.record("fleet", key, verdict, reason, **record)

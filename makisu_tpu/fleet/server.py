@@ -28,7 +28,6 @@ mid-stream failover; the terminal frame is emitted exactly once).
 from __future__ import annotations
 
 import collections
-import contextvars
 import json
 import os
 import socketserver
@@ -42,6 +41,7 @@ from makisu_tpu.fleet.scheduler import (
     WorkerSpec,
     build_identity,
 )
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -90,8 +90,11 @@ class _FleetHandler(BaseHTTPRequestHandler):
             self._respond(200 if ok else 503,
                           b"ok" if ok else b"no workers alive")
         elif self.path == "/metrics":
+            # Aggregated scrape: the front door's own series plus every
+            # alive worker's re-exported under a worker="wN" label —
+            # one Prometheus target sees the whole fleet.
             self._respond(
-                200, metrics.render_prometheus().encode(),
+                200, server.aggregated_metrics().encode(),
                 content_type="text/plain; version=0.0.4; "
                              "charset=utf-8")
         elif self.path == "/healthz":
@@ -153,12 +156,19 @@ class _FleetHandler(BaseHTTPRequestHandler):
             self._respond(400, b"bad argv json")
             return
         tenant = ""
+        traceparent = ""
         if isinstance(body, dict):
             argv = body.get("argv") or []
             tenant = str(body.get("tenant") or "")
+            traceparent = str(body.get("traceparent") or "")
         else:
             argv = body
         tenant = self.headers.get("X-Makisu-Tenant") or tenant
+        # The submitting client's trace context (header wins, like the
+        # tenant): the front door ADOPTS it for this build's admit/
+        # route/forward spans and hands its forward span down to the
+        # worker — one trace id, front door to chunk wire.
+        traceparent = self.headers.get("traceparent") or traceparent
         if not isinstance(argv, list) or not all(
                 isinstance(a, str) for a in argv):
             self._respond(400, b"bad argv json")
@@ -182,7 +192,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
                     finished.set()  # client gone; keep the build going
 
         try:
-            server.route_build(argv, tenant, emit)
+            server.route_build(argv, tenant, emit,
+                               traceparent=traceparent)
         finally:
             with emit_lock:
                 if not finished.is_set():
@@ -218,8 +229,8 @@ class FleetServer(socketserver.ThreadingMixIn,
                  max_inflight: int = 0,
                  spillover_queue_depth: int = 2,
                  max_attempts: int = MAX_ATTEMPTS,
-                 event_context: "contextvars.Context | None" = None,
-                 ) -> None:
+                 stall_window: float | None = None,
+                 diag_out: str = "") -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _FleetHandler)
@@ -228,8 +239,7 @@ class FleetServer(socketserver.ThreadingMixIn,
         self.scheduler = FleetScheduler(
             specs, poll_interval=poll_interval,
             tenant_quota=tenant_quota, max_inflight=max_inflight,
-            spillover_queue_depth=spillover_queue_depth,
-            event_context=event_context)
+            spillover_queue_depth=spillover_queue_depth)
         self._started_mono = time.monotonic()
         self._mu = threading.Lock()
         self._seq = 0
@@ -238,6 +248,34 @@ class FleetServer(socketserver.ThreadingMixIn,
         self._done_failed = 0
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=512)
+        # Failure forensics, at parity with WorkerServer: a process
+        # flight recorder sees every routed build's spans and every
+        # teed worker event (global sink), and an optional stall
+        # watchdog — gated on in-flight forwarded builds — dumps a
+        # bundle when the front door stops making progress mid-route.
+        from makisu_tpu.utils import flightrecorder, resources
+        resources.ensure_started()
+        self.recorder = flightrecorder.FlightRecorder()
+        self._recorder_sink = self.recorder.record_event
+        events.add_global_sink(self._recorder_sink)
+        # Merged-trace collector: every event this process sees — the
+        # front door's own admit/route/forward spans AND the worker
+        # build events the forwarder tees back in — in one bounded
+        # ring, the input `--trace-out` assembles into the merged
+        # Perfetto export at shutdown.
+        self._trace_events: collections.deque[dict] = \
+            collections.deque(maxlen=65536)
+        self._collector_sink = self._trace_events.append
+        events.add_global_sink(self._collector_sink)
+        self._watchdog = None
+        if stall_window is None:
+            stall_window = flightrecorder.stall_timeout_from_env()
+        if stall_window > 0:
+            self._watchdog = flightrecorder.StallWatchdog(
+                stall_window, self.recorder,
+                flightrecorder.forced_bundle_path(diag_out, "stall"),
+                registry=metrics.global_registry(),
+                active_fn=lambda: self.active_builds() > 0).start()
         self.scheduler.start()
 
     def get_request(self):
@@ -257,18 +295,80 @@ class FleetServer(socketserver.ThreadingMixIn,
         return t
 
     def server_close(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        events.remove_global_sink(self._collector_sink)
+        events.remove_global_sink(self._recorder_sink)
         self.scheduler.stop()
         super().server_close()
 
+    def active_builds(self) -> int:
+        with self._mu:
+            return len(self._pending)
+
+    def trace_events(self) -> list[dict]:
+        """Snapshot of the merged-trace collector ring (lock-free,
+        retried on concurrent mutation)."""
+        return metrics.snapshot_concurrent(self._trace_events)
+
+    def collect_serve_access(self) -> list[dict]:
+        """Fetch every alive worker's ``/serve/access`` ledger and
+        return the rows as worker-tagged ``serve_access`` events. In
+        a REAL fleet the workers are separate processes, so their
+        access rows (the bytes-on-wire input to the merged trace)
+        never reach this process's sinks on their own — the shutdown
+        merge pulls them here. Rows keep the ledger's own timestamps,
+        identical to the worker's direct emission, so in-process
+        fleets (which see both copies) dedupe in the assembler."""
+        from makisu_tpu.worker.client import WorkerClient
+        stats = self.scheduler.stats()
+        out: list[dict] = []
+        for w in stats["workers"]:
+            if not w["alive"]:
+                continue
+            client = WorkerClient(w["socket"], connect_timeout=2.0,
+                                  control_timeout=5.0, retries=0)
+            try:
+                conn, resp = client._control("/serve/access")
+                try:
+                    if resp.status != 200:
+                        continue
+                    entries = json.loads(resp.read()).get("entries",
+                                                          [])
+                finally:
+                    conn.close()
+            except (OSError, RuntimeError, ValueError):
+                continue
+            for entry in entries:
+                ev = dict(entry)
+                ev["type"] = "serve_access"
+                ev["worker"] = w["id"]
+                out.append(ev)
+        return out
+
     # -- the routing/forwarding path ---------------------------------------
 
-    def route_build(self, argv: list[str], tenant: str, emit) -> int:
+    def route_build(self, argv: list[str], tenant: str, emit,
+                    traceparent: str = "") -> int:
         """Admit, route, forward, failover. ``emit(line)`` streams
         NDJSON frames to the submitting client; the terminal frame is
         always emitted exactly once (a synthesized failure frame when
-        every attempt is exhausted)."""
+        every attempt is exhausted).
+
+        Every build gets its own trace registry here — ADOPTED from
+        the submitter's ``traceparent`` when one arrived (malformed
+        values mint fresh ids, counted) — so the front door's
+        admit/route/forward spans, the worker's build (which adopts
+        the forward span's context), and the peer/serve fetches it
+        issues all share ONE trace id. The spans leave the process as
+        events (global sinks: the flight recorder, the promoted
+        ``--events-out`` writer, the merged-trace collector)."""
         t0 = time.monotonic()
         context_key, command = build_identity(argv)
+        registry = metrics.MetricsRegistry()
+        metrics.adopt_inbound(registry, traceparent)
+        reg_token = metrics.set_build_registry(registry)
         with self._mu:
             self._seq += 1
             seq = self._seq
@@ -276,63 +376,89 @@ class FleetServer(socketserver.ThreadingMixIn,
                 "id": seq, "tenant": tenant, "state": "admitting",
                 "context": context_key, "command": command,
                 "worker": "", "enqueued_mono": t0,
+                "trace_id": registry.trace_id,
             }
         scheduler = self.scheduler
-        quota_wait = scheduler.admit(tenant, context_key)
+        quota_wait = 0.0
         exclude: set[str] = set()
         exit_code = 1
         terminal_sent = False
+        events.emit("build_start", trace_id=registry.trace_id,
+                    command="fleet_build", role="frontdoor",
+                    tenant=tenant or "")
         try:
-            for attempt in range(self.max_attempts):
-                try:
-                    worker, verdict, reason = scheduler.route(
-                        context_key, tenant, exclude=exclude,
-                        attempt=attempt)
-                except NoWorkersError as e:
-                    emit(json.dumps({"level": "error", "msg": str(e)}))
-                    break
-                with self._mu:
-                    row = self._pending.get(seq)
-                    if row is not None:
-                        row.update(state="forwarded",
-                                   worker=worker.spec.id,
-                                   verdict=verdict)
-                forward_argv = argv
-                if worker.spec.storage:
-                    forward_argv = rewrite_storage(argv,
-                                                   worker.spec.storage)
-                # No-wait admission only when a refusal still has
-                # somewhere ELIGIBLE to go (dead/draining workers are
-                # not alternatives), never for an affinity route —
-                # waiting at the session holder (~1.15s warm rebuild)
-                # beats a cold build elsewhere by ~50x — and never on
-                # the LAST attempt: a fully saturated fleet must end
-                # with the build queueing somewhere, not with every
-                # worker having politely refused it.
-                no_wait = (verdict != "affinity"
-                           and attempt + 1 < self.max_attempts
-                           and scheduler.eligible_count(
-                               exclude | {worker.spec.id}) >= 1)
-                outcome, code = self._forward(
-                    worker, forward_argv, tenant, emit, no_wait,
-                    terminal_extra={
-                        "worker": worker.spec.id,
-                        "fleet_verdict": verdict,
-                        "fleet_reason": reason,
-                        "fleet_attempts": attempt + 1,
-                        "quota_wait_seconds": round(quota_wait, 3),
-                    })
-                if outcome == "done":
-                    scheduler.note_build_done(worker.spec.id)
-                    exit_code = code
-                    terminal_sent = True
-                    return code
-                scheduler.note_worker_failure(worker.spec.id, outcome)
-                exclude.add(worker.spec.id)
-                log.warning("fleet: build attempt %d on %s failed "
-                            "(%s); failing over", attempt + 1,
-                            worker.spec.id, outcome)
-            return exit_code
+            with metrics.span("fleet_build", tenant=tenant or "",
+                              context=os.path.basename(context_key)
+                              if context_key else command or "?"):
+                with metrics.span("fleet_admit", tenant=tenant or ""):
+                    quota_wait = scheduler.admit(tenant, context_key)
+                for attempt in range(self.max_attempts):
+                    try:
+                        with metrics.span("fleet_route",
+                                          attempt=attempt):
+                            worker, verdict, reason = scheduler.route(
+                                context_key, tenant, exclude=exclude,
+                                attempt=attempt)
+                    except NoWorkersError as e:
+                        emit(json.dumps({"level": "error",
+                                         "msg": str(e)}))
+                        break
+                    with self._mu:
+                        row = self._pending.get(seq)
+                        if row is not None:
+                            row.update(state="forwarded",
+                                       worker=worker.spec.id,
+                                       verdict=verdict)
+                    forward_argv = argv
+                    if worker.spec.storage:
+                        forward_argv = rewrite_storage(
+                            argv, worker.spec.storage)
+                    # No-wait admission only when a refusal still has
+                    # somewhere ELIGIBLE to go (dead/draining workers
+                    # are not alternatives), never for an affinity
+                    # route — waiting at the session holder (~1.15s
+                    # warm rebuild) beats a cold build elsewhere by
+                    # ~50x — and never on the LAST attempt: a fully
+                    # saturated fleet must end with the build queueing
+                    # somewhere, not with every worker having politely
+                    # refused it.
+                    no_wait = (verdict != "affinity"
+                               and attempt + 1 < self.max_attempts
+                               and scheduler.eligible_count(
+                                   exclude | {worker.spec.id}) >= 1)
+                    # One forward span per attempt: failover attempts
+                    # land as SIBLING subtrees under fleet_build, each
+                    # carrying its worker/verdict — and the worker
+                    # adopts THIS span's context, so its whole build
+                    # tree nests under the attempt that ran it.
+                    with metrics.span("fleet_forward",
+                                      worker=worker.spec.id,
+                                      verdict=verdict,
+                                      attempt=attempt):
+                        outcome, code = self._forward(
+                            worker, forward_argv, tenant, emit,
+                            no_wait,
+                            terminal_extra={
+                                "worker": worker.spec.id,
+                                "fleet_verdict": verdict,
+                                "fleet_reason": reason,
+                                "fleet_attempts": attempt + 1,
+                                "quota_wait_seconds": round(
+                                    quota_wait, 3),
+                                "trace_id": registry.trace_id,
+                            })
+                    if outcome == "done":
+                        scheduler.note_build_done(worker.spec.id)
+                        exit_code = code
+                        terminal_sent = True
+                        return code
+                    scheduler.note_worker_failure(worker.spec.id,
+                                                  outcome)
+                    exclude.add(worker.spec.id)
+                    log.warning("fleet: build attempt %d on %s failed "
+                                "(%s); failing over", attempt + 1,
+                                worker.spec.id, outcome)
+                return exit_code
         finally:
             if not terminal_sent:
                 emit(json.dumps({
@@ -342,6 +468,7 @@ class FleetServer(socketserver.ThreadingMixIn,
                     "elapsed_seconds": round(time.monotonic() - t0, 3),
                     "quota_wait_seconds": round(quota_wait, 3),
                     "tenant": tenant,
+                    "trace_id": registry.trace_id,
                 }))
             scheduler.release(tenant)
             latency = time.monotonic() - t0
@@ -356,6 +483,9 @@ class FleetServer(socketserver.ThreadingMixIn,
                 metrics.FLEET_BUILD_LATENCY, latency,
                 buckets=_LATENCY_BUCKETS,
                 tenant=scheduler.tenant_label(tenant))
+            events.emit("build_end", trace_id=registry.trace_id,
+                        exit_code=exit_code)
+            metrics.reset_build_registry(reg_token)
 
     def _forward(self, worker, argv: list[str], tenant: str, emit,
                  no_wait: bool, terminal_extra: dict,
@@ -372,13 +502,33 @@ class FleetServer(socketserver.ThreadingMixIn,
             headers["X-Makisu-Tenant"] = tenant
         if no_wait:
             headers["X-Makisu-No-Wait"] = "1"
+        # The worker adopts the current span's context — the
+        # fleet_forward span this attempt runs under — so its whole
+        # build tree nests under this attempt in the merged trace.
+        # Fleet provenance rides the body into the build's history
+        # record (worker, verdict, attempts, quota wait).
+        headers["traceparent"] = metrics.current_traceparent()
+        body = json.dumps({
+            "argv": argv,
+            "fleet": {
+                # The scheduler-assigned id ("w0"), not the socket
+                # path: the worker records this as its history
+                # provenance, and every other surface (terminal
+                # frames, top, doctor, report --fleet) names workers
+                # by id — the history record must cross-reference.
+                "worker": terminal_extra.get("worker", ""),
+                "verdict": terminal_extra.get("fleet_verdict", ""),
+                "attempts": terminal_extra.get("fleet_attempts", 1),
+                "quota_wait_seconds": terminal_extra.get(
+                    "quota_wait_seconds", 0.0),
+            },
+        }).encode()
         conn = _UnixHTTPConnection(worker.spec.socket_path,
                                    STREAM_READ_TIMEOUT,
                                    connect_timeout=5.0)
         try:
             try:
-                conn.request("POST", "/build",
-                             body=json.dumps(argv).encode(),
+                conn.request("POST", "/build", body=body,
                              headers=headers)
                 resp = conn.getresponse()
             except (OSError, http_client.HTTPException):
@@ -418,6 +568,24 @@ class FleetServer(socketserver.ThreadingMixIn,
                         payload.update(terminal_extra)
                         emit(json.dumps(payload))
                         return "done", terminal_exit_code(payload)
+                    # Tee worker build events into the front door's
+                    # own sinks (worker-tagged, original timestamps)
+                    # — this is what makes the fleet's --events-out /
+                    # merged trace CROSS-process: the worker's span
+                    # events land beside the forward span that owns
+                    # them. The frame still forwards to the client
+                    # verbatim.
+                    if b'"event"' in line:
+                        try:
+                            frame = json.loads(line)
+                        except ValueError:
+                            frame = None
+                        if isinstance(frame, dict) \
+                                and isinstance(frame.get("event"),
+                                               dict):
+                            teed = dict(frame["event"])
+                            teed.setdefault("worker", worker.spec.id)
+                            events.deliver(teed)
                     emit(line.decode(errors="replace"))
                 # EOF without a terminal frame: the worker died.
                 return "midstream", 1
@@ -430,15 +598,94 @@ class FleetServer(socketserver.ThreadingMixIn,
 
     # -- introspection -----------------------------------------------------
 
+    def aggregated_metrics(self) -> str:
+        """The fleet ``GET /metrics`` payload: the front door's own
+        process series plus every ALIVE worker's scrape re-exported
+        under a ``worker="wN"`` label, merged into one valid
+        exposition (one family group per metric) — a single Prometheus
+        target covers the whole fleet. A worker whose scrape fails
+        costs its own timeout and a counted error, never the whole
+        response. The scrapes fan out in parallel, like /builds."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from makisu_tpu.worker.client import WorkerClient
+        stats = self.scheduler.stats()
+        alive = [w for w in stats["workers"] if w["alive"]]
+        g = metrics.global_registry()
+
+        def scrape(w):
+            client = WorkerClient(w["socket"], connect_timeout=2.0,
+                                  control_timeout=5.0, retries=0)
+            try:
+                text = client.metrics()
+            except (OSError, RuntimeError, ValueError):
+                g.counter_add(metrics.FLEET_AGGREGATED_SCRAPES,
+                              result="error")
+                return w, None
+            g.counter_add(metrics.FLEET_AGGREGATED_SCRAPES,
+                          result="ok")
+            return w, text
+
+        if alive:
+            with ThreadPoolExecutor(min(8, len(alive))) as pool:
+                fetched = list(pool.map(scrape, alive))
+        else:
+            fetched = []
+        parts = [metrics.render_prometheus()]
+        for w, text in fetched:
+            if text is not None:
+                parts.append(metrics.relabel_prometheus(
+                    text, worker=w["id"]))
+        return metrics.merge_prometheus(parts)
+
     def health(self) -> dict:
         """Worker-shaped ``/healthz`` (so ``top`` and WorkerClient
-        work against the fleet socket) plus the ``fleet`` section."""
+        work against the fleet socket) plus the ``fleet`` section and
+        a ``self`` section — the front door's OWN vitals (ROADMAP item
+        1 named it the fleet's observability blind spot): poll ages,
+        peer-map version fan-out, decision-ring stats, progress
+        clock, forensics armament."""
+        from makisu_tpu.utils import flightrecorder
         stats = self.scheduler.stats()
         with self._mu:
             pending = len(self._pending)
             ok, failed = self._done_ok, self._done_failed
             latencies = list(self._latencies)
         alive = [w for w in stats["workers"] if w["alive"]]
+        poll_ages = [w["last_poll_age_seconds"]
+                     for w in stats["workers"]
+                     if w["last_poll_age_seconds"] is not None]
+        decisions = stats.get("recent_decisions", [])
+        ring_verdicts: dict[str, int] = {}
+        for row in decisions:
+            v = row.get("verdict", "?")
+            ring_verdicts[v] = ring_verdicts.get(v, 0) + 1
+        version = stats["peer_map_version"]
+        acked = stats.get("peer_acked", {})
+        stale_acks = sorted(
+            w["id"] for w in alive
+            if acked.get(w["id"]) is not None
+            and acked[w["id"]] < version)
+        g = metrics.global_registry()
+        self_section = {
+            "poll_interval_seconds": self.scheduler.poll_interval,
+            "oldest_poll_age_seconds": (round(max(poll_ages), 3)
+                                        if poll_ages else None),
+            "peer_map": {
+                "version": version,
+                "acked": acked,
+                "stale_acks": stale_acks,
+            },
+            "decision_ring": {
+                "size": len(decisions),
+                "verdicts": ring_verdicts,
+            },
+            "last_progress_seconds": round(
+                flightrecorder.last_progress_seconds(), 3),
+            "events_dropped": int(g.counter_total(
+                "makisu_events_dropped_total")),
+            "watchdog_armed": self._watchdog is not None,
+        }
         return {
             "status": "ok" if alive else "degraded",
             "role": "fleet",
@@ -448,6 +695,8 @@ class FleetServer(socketserver.ThreadingMixIn,
             "builds_succeeded": ok,
             "builds_failed": failed,
             "active_builds": pending,
+            "last_progress_seconds": round(
+                flightrecorder.last_progress_seconds(), 3),
             "queue": {
                 "depth": stats["frontdoor_waiting"],
                 "max_concurrent_builds": 0,
@@ -456,6 +705,7 @@ class FleetServer(socketserver.ThreadingMixIn,
                 "tenant_latency_seconds": {},
             },
             "fleet": stats,
+            "self": self_section,
         }
 
     def builds(self) -> dict:

@@ -79,7 +79,17 @@ class ServeClient:
     def _request(self, path: str, headers: dict | None):
         """One GET on this thread's pooled connection. A stale pooled
         socket (server idled it out between requests) retries ONCE on
-        a fresh connection; a failure on a fresh one propagates."""
+        a fresh connection; a failure on a fresh one propagates.
+
+        EVERY serve-plane request carries the caller's trace context
+        (the fetching build's adopted trace id) — injected here, the
+        single choke point, so the ranged pack/zpack fetches that move
+        the actual bytes correlate in the server's access ledger, not
+        just the recipe lookups. An explicit caller header wins; same
+        injection the registry/KV planes have done since PR 2."""
+        headers = dict(headers or {})
+        headers.setdefault("traceparent",
+                           metrics.current_traceparent())
         conn = getattr(self._local, "conn", None)
         self._local.conn = None
         fresh = conn is None
@@ -87,7 +97,7 @@ class ServeClient:
             conn = self._connect()
         while True:
             try:
-                conn.request("GET", path, headers=headers or {})
+                conn.request("GET", path, headers=headers)
                 return conn, conn.getresponse()
             except (OSError, http.client.HTTPException):
                 conn.close()

@@ -25,13 +25,16 @@ need.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import socketserver
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 
 from makisu_tpu.serve import recipe as recipe_mod
+from makisu_tpu.utils import events
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
 
@@ -115,6 +118,66 @@ def serve_stats(roots=None) -> dict:
     return out
 
 
+# -- serve access ledger -----------------------------------------------------
+
+
+class AccessLog:
+    """Per-server ring of recent serve-plane requests — the
+    cross-process half of a traced fetch. Every recipe/pack/zpack/
+    chunk request lands here with the INBOUND trace id (the
+    ``traceparent`` the fetching build sent), so a peer or delta fetch
+    correlates with the build that issued it without grepping two
+    machines' logs. Exposed as ``GET /serve/access``; each row also
+    rides the event bus as a ``serve_access`` event (global sinks —
+    the worker's flight recorder, a fleet's merged event log)."""
+
+    def __init__(self, cap: int = 256) -> None:
+        self._mu = threading.Lock()
+        self._rows: collections.deque[dict] = collections.deque(
+            maxlen=cap)
+
+    def record(self, kind: str, name: str, status: int, nbytes: int,
+               trace_id: str) -> None:
+        row = {
+            "ts": round(time.time(), 6),
+            "kind": kind,
+            "name": name,
+            "status": int(status),
+            "bytes": int(nbytes),
+            "trace_id": trace_id or "",
+        }
+        with self._mu:
+            self._rows.append(row)
+        metrics.global_registry().counter_add(
+            metrics.SERVE_ACCESS_TOTAL, kind=kind)
+        # Delivered PRE-FORMED with the ledger row's own ts, so the
+        # event and the /serve/access row are byte-equal — a fleet
+        # that sees both (an in-process worker's direct emission AND
+        # the shutdown collection of its ledger) dedups them by
+        # identical fields in assemble_fleet_trace.
+        events.deliver({**row, "type": "serve_access"})
+
+    def snapshot(self) -> list[dict]:
+        with self._mu:
+            return list(self._rows)
+
+
+def inbound_trace_id(handler) -> str:
+    """The validated trace id of a request's ``traceparent`` header,
+    or "" — never raises on a malformed header (a lying client costs
+    correlation, not a request)."""
+    parsed = metrics.parse_traceparent(
+        handler.headers.get("traceparent") or "")
+    return parsed[0] if parsed else ""
+
+
+def _note_access(handler, access: "AccessLog | None", kind: str,
+                 name: str, status: int, nbytes: int = 0) -> None:
+    if access is not None:
+        access.record(kind, name, status, nbytes,
+                      inbound_trace_id(handler))
+
+
 # -- request handling (shared by ServeServer and WorkerServer) ---------------
 
 
@@ -141,7 +204,8 @@ def parse_range(header: str | None, size: int):
     return start, min(end, size)
 
 
-def handle_recipe(handler, name: str, roots=None) -> None:
+def handle_recipe(handler, name: str, roots=None,
+                  access: "AccessLog | None" = None) -> None:
     """``GET /recipes/<layer_hex>`` → the sealed recipe document."""
     g = metrics.global_registry()
     if not recipe_mod.is_hex_digest(name):
@@ -151,15 +215,19 @@ def handle_recipe(handler, name: str, roots=None) -> None:
         doc = store.recipe(name)
         if doc is not None:
             g.counter_add(metrics.SERVE_RECIPE_REQUESTS, result="hit")
-            _respond(handler, 200,
-                     json.dumps(doc, separators=(",", ":")).encode(),
+            body = json.dumps(doc, separators=(",", ":")).encode()
+            _note_access(handler, access, "recipe", name, 200,
+                         len(body))
+            _respond(handler, 200, body,
                      content_type="application/json")
             return
     g.counter_add(metrics.SERVE_RECIPE_REQUESTS, result="miss")
+    _note_access(handler, access, "recipe", name, 404)
     _respond(handler, 404, b"no recipe for this layer")
 
 
-def handle_pack(handler, name: str, roots=None) -> None:
+def handle_pack(handler, name: str, roots=None,
+                access: "AccessLog | None" = None) -> None:
     """``GET /packs/<pack_hex>`` with optional Range: stream the span,
     synthesized from chunks, through the transfer memory budget."""
     from makisu_tpu.registry import transfer
@@ -174,12 +242,14 @@ def handle_pack(handler, name: str, roots=None) -> None:
             break
     if store is None:
         g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="miss")
+        _note_access(handler, access, "pack", name, 404)
         _respond(handler, 404, b"pack not held here")
         return
     size = store.pack_size(name)
     span = parse_range(handler.headers.get("Range"), size)
     if span == "unsatisfiable":
         g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="bad_range")
+        _note_access(handler, access, "pack", name, 416)
         _respond(handler, 416, b"range not satisfiable")
         return
     start, end = span if span is not None else (0, size)
@@ -204,6 +274,8 @@ def handle_pack(handler, name: str, roots=None) -> None:
                       kind="range" if span is not None else "full")
         g.counter_add(metrics.SERVE_PACK_BYTES, sent)
         g.counter_add(metrics.SERVE_WIRE_BYTES, sent, encoding="raw")
+        _note_access(handler, access, "pack", name,
+                     206 if span is not None else 200, sent)
     except (FileNotFoundError, ValueError) as e:
         # Member chunk evicted (FileNotFoundError) or truncated on
         # disk (ValueError) after the headers went out: the body is
@@ -219,7 +291,8 @@ def handle_pack(handler, name: str, roots=None) -> None:
         pass  # client hung up mid-stream; not our problem
 
 
-def handle_zpack(handler, name: str, roots=None) -> None:
+def handle_zpack(handler, name: str, roots=None,
+                 access: "AccessLog | None" = None) -> None:
     """``GET /zpacks/<pack_hex>`` with optional Range: the pack's
     seekable-zstd twin — independently-decompressible frames, ranges
     over COMPRESSED bytes — streamed from the frame file under the
@@ -239,12 +312,14 @@ def handle_zpack(handler, name: str, roots=None) -> None:
             break
     if store is None:
         g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="zmiss")
+        _note_access(handler, access, "zpack", name, 404)
         _respond(handler, 404, b"no seekable pack held here")
         return
     size = store.zpack_size(name)
     span = parse_range(handler.headers.get("Range"), size)
     if span == "unsatisfiable":
         g.counter_add(metrics.SERVE_PACK_REQUESTS, kind="bad_range")
+        _note_access(handler, access, "zpack", name, 416)
         _respond(handler, 416, b"range not satisfiable")
         return
     start, end = span if span is not None else (0, size)
@@ -269,6 +344,8 @@ def handle_zpack(handler, name: str, roots=None) -> None:
                       kind="zrange" if span is not None else "zfull")
         g.counter_add(metrics.SERVE_PACK_FRAMES, served_frames)
         g.counter_add(metrics.SERVE_WIRE_BYTES, sent, encoding="zstd")
+        _note_access(handler, access, "zpack", name,
+                     206 if span is not None else 200, sent)
     except (FileNotFoundError, ValueError) as e:
         # Frame file gone/truncated after headers went out: close so
         # the short body is immediate (same discipline as handle_pack).
@@ -303,11 +380,21 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if self.path == "/ready":
             _respond(self, 200, b"ok")
         elif self.path.startswith("/recipes/"):
-            handle_recipe(self, self.path[len("/recipes/"):])
+            handle_recipe(self, self.path[len("/recipes/"):],
+                          access=self.server.serve_access)
         elif self.path.startswith("/packs/"):
-            handle_pack(self, self.path[len("/packs/"):])
+            handle_pack(self, self.path[len("/packs/"):],
+                        access=self.server.serve_access)
         elif self.path.startswith("/zpacks/"):
-            handle_zpack(self, self.path[len("/zpacks/"):])
+            handle_zpack(self, self.path[len("/zpacks/"):],
+                         access=self.server.serve_access)
+        elif self.path == "/serve/access":
+            # The access ledger: recent serve-plane requests with the
+            # inbound trace id of each — the server-side rows a merged
+            # fleet trace (and a curious operator) correlates against.
+            _respond(self, 200, json.dumps({
+                "entries": self.server.serve_access.snapshot(),
+            }).encode(), content_type="application/json")
         elif self.path == "/metrics":
             _respond(self, 200,
                      metrics.render_prometheus().encode(),
@@ -353,6 +440,9 @@ class ServeServer(socketserver.ThreadingMixIn,
             os.path.join(storage_dir, "chunks"))
         chunks_mod.register_serving_store(self._chunk_store)
         self.store = register_store(storage_dir)
+        # Per-server access ledger: this endpoint's own request rows
+        # (trace-id-stamped), never a sibling's.
+        self.serve_access = AccessLog()
         # Deliberately NOT enable_publishing(): this server is
         # read-only — it never indexes layers, so the flag would only
         # leak publish cost into builds an embedder (bench, tests)

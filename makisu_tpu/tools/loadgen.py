@@ -245,12 +245,20 @@ def run(args) -> int:
             if args.history_out:
                 argv = ["--history-out", args.history_out] + argv
             t0 = time.monotonic()
+            # Each synthetic build gets its OWN trace registry, so the
+            # worker-side build adopts a distinct trace id per
+            # submission (stitching without collapsing concurrent
+            # lanes into one trace).
+            lane_reg = metrics.MetricsRegistry()
+            reg_token = metrics.set_build_registry(lane_reg)
             try:
                 code = client.build(argv, tenant=tenant)
             except (OSError, RuntimeError) as e:
                 code = -1
                 log.error("loadgen lane %d build %d failed to "
                           "submit: %s", i, seq, e)
+            finally:
+                metrics.reset_build_registry(reg_token)
             elapsed = time.monotonic() - t0
             terminal = client.last_build or {}
             queue_wait = float(terminal.get("queue_wait_seconds",
@@ -524,6 +532,10 @@ def _drive_rounds(socket_path: str, contexts: list[str],
             if isinstance(storage_for, str):
                 argv += ["--storage", storage_for]
             t0 = time.monotonic()
+            # Per-build trace registry: each round's build stitches
+            # under its own trace id through the front door.
+            drive_reg = metrics.MetricsRegistry()
+            reg_token = metrics.set_build_registry(drive_reg)
             try:
                 code = client.build(argv, tenant=tenant)
             except (OSError, RuntimeError,
@@ -536,6 +548,8 @@ def _drive_rounds(socket_path: str, contexts: list[str],
                 code = -1
                 log.error("fleet loadgen ctx %d round %d failed to "
                           "submit: %s", j, r, e)
+            finally:
+                metrics.reset_build_registry(reg_token)
             elapsed = time.monotonic() - t0
             terminal = client.last_build or {}
             worker = str(terminal.get("worker", ""))
@@ -615,6 +629,7 @@ def _run_fleet(args) -> int:
     disruption = {"drained": "", "killed": ""}
     sampler = None
     fleet_stats: dict = {}
+    fleet_metrics_text = ""
     wall = 0.0
 
     def spawn_worker(wid: str):
@@ -752,6 +767,15 @@ def _run_fleet(args) -> int:
         wall = time.monotonic() - t0
         fleet_stats = json.loads(_front_get(
             fleet_server.socket_path, "/fleet"))
+        # One scrape of the front door's AGGREGATED /metrics covers
+        # the whole fleet (each worker's series re-exported under a
+        # worker label): occupancy parses from it exactly like the
+        # single-worker path, and the distinct worker labels prove
+        # the aggregation actually fanned out.
+        try:
+            fleet_metrics_text = front.metrics()
+        except (OSError, RuntimeError):
+            fleet_metrics_text = ""
     finally:
         if sampler is not None:
             sampler.stop()
@@ -776,7 +800,8 @@ def _run_fleet(args) -> int:
                                  disruption, fleet_stats, sampler,
                                  wall, baseline_wall, tenants,
                                  n_workers, n_ctx, rounds,
-                                 metrics.global_registry())
+                                 metrics.global_registry(),
+                                 fleet_metrics_text)
     if args.report:
         metrics.write_json_atomic(args.report, report)
         log.info("fleet loadgen report written to %s", args.report)
@@ -803,7 +828,7 @@ def _front_get(socket_path: str, path: str) -> bytes:
 def _build_fleet_report(args, results, baseline_results, disruption,
                         fleet_stats, sampler, wall, baseline_wall,
                         tenants, n_workers, n_ctx, rounds,
-                        registry) -> dict:
+                        registry, fleet_metrics_text="") -> dict:
     ok_rows = [r for r in results if r["exit_code"] == 0]
     latencies = [r["latency_seconds"] for r in ok_rows]
     base_ok = [r for r in baseline_results if r["exit_code"] == 0]
@@ -917,7 +942,10 @@ def _build_fleet_report(args, results, baseline_results, disruption,
                 [r["latency_seconds"] for r in ok_rows
                  if r["tenant"] == tenant])
             for tenant in tenants},
-        "hash_batch_occupancy": None,
+        # Parsed from the front door's AGGREGATED scrape — one target,
+        # every worker's series under a worker label.
+        "hash_batch_occupancy": _occupancy_from_metrics(
+            fleet_metrics_text) if fleet_metrics_text else None,
         "queue_wait_share": 0.0,
         "tenant_fairness_p99_ratio": 1.0,
         "throughput_builds_per_s": round(len(results) / wall, 3)
@@ -962,6 +990,13 @@ def _build_fleet_report(args, results, baseline_results, disruption,
             "p99_ratio": round(fleet_p99 / base_p99, 3)
             if base_p99 else 0.0,
             "workers": fleet_stats.get("workers", []),
+            # Distinct worker labels seen in the front door's
+            # aggregated /metrics scrape — proof the re-export fanned
+            # out (survivors only; dead/killed workers scrape as
+            # errors, not silence).
+            "aggregated_scrape_workers": sorted(set(
+                re.findall(r'worker="([^"]+)"',
+                           fleet_metrics_text))),
         },
         "results": results,
         "baseline_results": baseline_results,

@@ -28,26 +28,41 @@ def _trunc(text: str, width: int) -> str:
     return text if len(text) <= width else text[:width - 1] + "…"
 
 
-def _fleet_lines(fleet: dict) -> list[str]:
+def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
     """The fleet section: one row per worker (state, load, resident
-    sessions, routing share) plus the scheduler's verdict tallies."""
+    sessions, poll age, peer-map ack, routing share) plus the
+    scheduler's verdict tallies."""
+    self_section = self_section or {}
+    peer_map = self_section.get("peer_map", {})
+    acked = peer_map.get("acked", {})
+    stale = set(peer_map.get("stale_acks") or [])
     lines = [
         "",
         f"fleet — {len(fleet.get('workers', []))} workers   "
         f"front-door queued {fleet.get('frontdoor_waiting', 0)}   "
         f"tenant quota "
         f"{fleet.get('tenant_quota', 0) or 'off'}   "
-        f"peer map v{fleet.get('peer_map_version', 0)}",
+        f"peer map v{fleet.get('peer_map_version', 0)}"
+        + (f" ({len(stale)} stale ack(s))" if stale else ""),
         f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} {'QUEUE':>6s} "
-        f"{'SESS':>5s} {'ROUTED':>7s}  SOCKET",
+        f"{'SESS':>5s} {'POLL':>6s} {'PEERMAP':>8s} "
+        f"{'ROUTED':>7s}  SOCKET",
     ]
     for w in fleet.get("workers", []):
+        wid = w.get("id", "?")
+        poll_age = w.get("last_poll_age_seconds")
+        held = acked.get(wid)
+        peermap = f"v{held}" if held is not None else "-"
+        if wid in stale:
+            peermap += "!"
         lines.append(
-            f"{_trunc(w.get('id', '?'), 8):<8s} "
+            f"{_trunc(wid, 8):<8s} "
             f"{w.get('state', '?'):<9s} "
             f"{w.get('active_builds', 0):>6d} "
             f"{w.get('queue_depth', 0):>6d} "
             f"{len(w.get('sessions', [])):>5d} "
+            f"{_fmt_age(poll_age) if poll_age is not None else '-':>6s} "
+            f"{peermap:>8s} "
             f"{w.get('routed_total', 0):>7d}  "
             f"{_trunc(w.get('socket', ''), 36)}")
     totals = fleet.get("route_totals", {})
@@ -111,7 +126,7 @@ def render_top(health: dict, builds: dict, socket_path: str) -> str:
     if not rows:
         lines.append("  (no builds in flight)")
     if fleet:
-        lines.extend(_fleet_lines(fleet))
+        lines.extend(_fleet_lines(fleet, health.get("self")))
     recent = list(builds.get("recent", []))[:8]
     if recent:
         lines.append("")

@@ -177,6 +177,22 @@ def emit(event_type: str, **fields: Any) -> None:
     event: dict[str, Any] = {"ts": round(time.time(), 6),
                              "type": event_type}
     event.update(fields)
+    _fan_out(event, sinks)
+
+
+def deliver(event: dict) -> None:
+    """Deliver a PRE-FORMED event (already carrying its own ``ts`` and
+    ``type``) to every bound sink — the fleet front door uses this to
+    tee a worker's streamed build events into its own event log
+    without re-stamping them as if they happened here. Same progress
+    stamp and swallow-and-count semantics as :func:`emit`."""
+    note_progress()
+    sinks = _sinks.get() + _global_sinks
+    if sinks:
+        _fan_out(event, sinks)
+
+
+def _fan_out(event: dict, sinks: tuple[EventSink, ...]) -> None:
     for sink in sinks:
         try:
             sink(event)
@@ -185,9 +201,28 @@ def emit(event_type: str, **fields: Any) -> None:
             try:
                 from makisu_tpu.utils import metrics
                 metrics.counter_add("makisu_events_dropped_total",
-                                    event_type=event_type)
+                                    event_type=event.get("type", "?"))
             except Exception:  # noqa: BLE001 - never recurse into failure
                 pass
+
+
+def promote_context_sinks() -> tuple[EventSink, ...]:
+    """Re-register the current context's sinks as PROCESS-WIDE sinks
+    and return them (for symmetric :func:`demote_sinks`). The fleet
+    front door uses this: ``cli.main`` binds ``--events-out`` /
+    ``--explain-out`` writers in the invocation's context, but the
+    server's handler and poll threads have no bound context — without
+    promotion, every front-door decision and span would silently miss
+    the files the operator asked for."""
+    sinks = _sinks.get()
+    for sink in sinks:
+        add_global_sink(sink)
+    return sinks
+
+
+def demote_sinks(sinks: tuple[EventSink, ...]) -> None:
+    for sink in sinks:
+        remove_global_sink(sink)
 
 
 class JsonlWriter:

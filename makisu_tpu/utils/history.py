@@ -29,6 +29,7 @@ fail a build (``cli.main`` guards the append).
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import sys
@@ -51,6 +52,31 @@ _GATES: tuple[tuple[str, str], ...] = (
     ("cache_hit_ratio", "down"),
     ("chunk_dedup_ratio", "down"),
 )
+
+
+# Fleet provenance for the NEXT record this context appends: the
+# worker binds it (from the front door's forwarded routing outcome)
+# around each /build it serves, so a build that arrived via the fleet
+# records WHERE it ran and WHY it was routed there — the signal
+# `history diff` needs to attribute a latency swing to a routing-mix
+# change instead of a code change.
+_fleet_provenance: "contextvars.ContextVar[dict | None]" = \
+    contextvars.ContextVar("makisu_history_fleet", default=None)
+
+
+def bind_fleet_provenance(info: dict):
+    """Bind this build's fleet routing provenance (worker socket,
+    verdict, attempts, quota wait) in the current context. Returns a
+    reset token."""
+    return _fleet_provenance.set(dict(info))
+
+
+def reset_fleet_provenance(token) -> None:
+    _fleet_provenance.reset(token)
+
+
+def fleet_provenance() -> dict | None:
+    return _fleet_provenance.get()
 
 
 def resolve_out(flag: str) -> str:
@@ -159,6 +185,13 @@ def record_from_report(report: dict, command: str = "",
         # regression — `history diff` names the change.
         "warm_mode": warm_mode_label(),
     }
+    # Fleet provenance (bound by the worker when a build arrived via
+    # the front door): worker socket + routing verdict + attempt count
+    # + front-door quota wait. Absent on direct builds — its presence
+    # IS the route label the routing-mix aggregate counts.
+    fleet = fleet_provenance()
+    if fleet is not None:
+        record["fleet"] = dict(fleet)
     record.update(extra)
     return record
 
@@ -248,6 +281,24 @@ def aggregate(records: list[dict]) -> dict:
             warm[label] = warm.get(label, 0) + 1
     if warm:
         out["warm_mode"] = max(sorted(warm), key=warm.get)
+    # Routing mix: how these builds reached their process — "fleet"
+    # (front-door provenance present) vs "direct" — plus the dominant
+    # worker among fleet-routed records. A latency swing that rides a
+    # routing change (warm affinity landing elsewhere, a failover-heavy
+    # run) is topology, not code; `history diff` names it like the
+    # device-route and warm-mode labels.
+    via_fleet = [r for r in records if isinstance(r.get("fleet"), dict)]
+    if records:
+        out["routing"] = ("fleet" if len(via_fleet) * 2 > len(records)
+                          else "direct")
+        out["fleet_routed"] = len(via_fleet)
+    workers: dict[str, int] = {}
+    for r in via_fleet:
+        worker = str(r["fleet"].get("worker", ""))
+        if worker:
+            workers[worker] = workers.get(worker, 0) + 1
+    if workers:
+        out["dominant_worker"] = max(sorted(workers), key=workers.get)
     return out
 
 
@@ -306,6 +357,18 @@ def diff(a: list[dict], b: list[dict],
     wa, wb = agg_a.get("warm_mode"), agg_b.get("warm_mode")
     if wa and wb and wa != wb:
         result["warm_mode_change"] = {"baseline": wa, "candidate": wb}
+    # Routing-mix attribution: direct → fleet (or a dominant-worker
+    # flip) changes which machine's warm state and disks served the
+    # builds — name it next to the latency gates.
+    ra, rb = agg_a.get("routing"), agg_b.get("routing")
+    dwa = agg_a.get("dominant_worker")
+    dwb = agg_b.get("dominant_worker")
+    if (ra and rb and ra != rb) or (dwa and dwb and dwa != dwb):
+        result["routing_change"] = {
+            "baseline": ra, "candidate": rb,
+            **({"baseline_worker": dwa, "candidate_worker": dwb}
+               if dwa != dwb and (dwa or dwb) else {}),
+        }
     return result
 
 
@@ -390,6 +453,19 @@ def render_diff(result: dict) -> str:
             f"  warm mode: {warm_change['baseline']} → "
             f"{warm_change['candidate']}  (latency deltas may be "
             f"residency state, not code)")
+    routing_change = result.get("routing_change")
+    if routing_change:
+        detail = f"{routing_change['baseline']} → " \
+                 f"{routing_change['candidate']}"
+        if routing_change.get("baseline_worker") \
+                or routing_change.get("candidate_worker"):
+            detail += (f" (worker "
+                       f"{routing_change.get('baseline_worker') or '-'}"
+                       f" → "
+                       f"{routing_change.get('candidate_worker') or '-'})")
+        lines.append(
+            f"  routing mix: {detail}  (latency deltas may be fleet "
+            f"placement, not code)")
     lines.append("")
     if result["regressions"]:
         names = ", ".join(r["metric"] for r in result["regressions"])

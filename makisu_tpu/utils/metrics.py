@@ -146,6 +146,19 @@ SESSION_HITS = "makisu_session_hits"
 SESSION_INVALIDATIONS = "makisu_session_invalidations_total"
 SESSION_RESIDENT_BYTES = "makisu_session_resident_bytes"
 
+# Fleet-wide trace stitching: inbound traceparent adoption outcomes
+# (result=adopted|malformed — a malformed header mints fresh ids and
+# is COUNTED, never crashed on), and the front door's aggregated
+# /metrics fan-out (result=ok|error per worker scrape).
+TRACE_ADOPTED = "makisu_trace_adopted_total"
+FLEET_AGGREGATED_SCRAPES = "makisu_fleet_aggregated_scrapes_total"
+
+# Serve access ledger (serve/server.py AccessLog): per-request rows
+# keyed by the inbound traceparent, the cross-process half of a peer/
+# delta fetch's trace. The counter tallies rows by kind so the ring's
+# churn is visible on /metrics.
+SERVE_ACCESS_TOTAL = "makisu_serve_access_total"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.
@@ -201,6 +214,74 @@ def new_id(nbytes: int) -> str:
     """Random lowercase-hex identifier of ``2 * nbytes`` characters.
     W3C trace ids are 16 bytes, span ids 8 (trace-context §3.2.2.3-4)."""
     return os.urandom(nbytes).hex()
+
+
+def parse_traceparent(value: str) -> tuple[str, str] | None:
+    """Validate a W3C ``traceparent`` header value and return
+    ``(trace_id, parent_span_id)``, or ``None`` for anything
+    malformed. Strict by the spec's §3.2: four dash-separated fields,
+    a known 2-hex version (``ff`` is reserved-invalid), 32/16
+    lowercase-hex ids, neither all-zero, a 2-hex flags field. Callers
+    MUST mint fresh ids on ``None`` — a bad header from a buggy proxy
+    can cost stitching, never a build."""
+    if not isinstance(value, str):
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    hexdigits = set("0123456789abcdef")
+    for field, width in ((version, 2), (trace_id, 32),
+                         (span_id, 16), (flags, 2)):
+        if len(field) != width or not set(field) <= hexdigits:
+            return None
+    if version == "ff":
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+# Inbound trace context for the NEXT registry this context creates:
+# the worker's /build handler (and anything else accepting a build on
+# behalf of an upstream caller) binds the raw traceparent here, and
+# ``cli.main`` adopts it into the build's fresh registry — so the
+# front door's forward span, the worker's build spans, and every
+# outbound request the build issues share ONE trace id.
+_inbound_traceparent: "contextvars.ContextVar[str]" = \
+    contextvars.ContextVar("makisu_inbound_traceparent", default="")
+
+
+def bind_inbound_traceparent(value: str):
+    """Bind a raw inbound ``traceparent`` in the current context
+    (validated only at adoption time). Returns a reset token."""
+    return _inbound_traceparent.set(value or "")
+
+
+def reset_inbound_traceparent(token) -> None:
+    _inbound_traceparent.reset(token)
+
+
+def inbound_traceparent() -> str:
+    return _inbound_traceparent.get()
+
+
+def adopt_inbound(registry: "MetricsRegistry", value: str) -> str:
+    """Adopt a raw inbound traceparent into ``registry`` — THE
+    adoption policy, shared by ``cli.main`` and the fleet forwarder so
+    the semantics (and the ``makisu_trace_adopted_total`` counting)
+    can never diverge between the two doors. Returns ``"adopted"``,
+    ``"malformed"`` (fresh ids kept, counted), or ``""`` (no inbound
+    value at all)."""
+    if not value:
+        return ""
+    parsed = parse_traceparent(value)
+    if parsed is None:
+        _global.counter_add(TRACE_ADOPTED, result="malformed")
+        return "malformed"
+    registry.adopt_trace(*parsed)
+    _global.counter_add(TRACE_ADOPTED, result="adopted")
+    return "adopted"
 
 
 class Span:
@@ -296,6 +377,18 @@ class MetricsRegistry:
         # is correlatable with registry/KV server logs.
         self.trace_id = new_id(16)
         self.root = Span("root", {}, self)
+
+    def adopt_trace(self, trace_id: str, parent_span_id: str) -> None:
+        """Adopt an upstream trace context (a validated traceparent):
+        this registry's spans join the caller's trace instead of
+        minting a fresh one. The ROOT span takes the caller's span id,
+        so the first real span this registry opens carries
+        ``parent_id = <caller's span>`` — the cross-process stitch a
+        merged trace assembles on. Call before any span opens (the
+        adoption point in ``cli.main`` is right after the registry is
+        bound)."""
+        self.trace_id = trace_id
+        self.root.span_id = parent_span_id
 
     # -- writes -----------------------------------------------------------
 
@@ -576,8 +669,11 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
         parent.children.append(s)
     _open_spans[id(s)] = s
     token = _current_span.set(s)
+    # trace_id rides every span event so a multi-build event stream
+    # (a worker's global sinks, the fleet front door's merged log) can
+    # be partitioned back into per-trace span trees.
     events.emit("span_start", name=name, span_id=s.span_id,
-                parent_id=s.parent_id,
+                parent_id=s.parent_id, trace_id=reg.trace_id,
                 **({"attrs": s.attrs} if s.attrs else {}))
     try:
         yield s
@@ -590,7 +686,20 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
         _current_span.reset(token)
         events.emit("span_end", name=name, span_id=s.span_id,
                     duration=round(s.duration, 6),
+                    trace_id=reg.trace_id,
                     **({"error": s.error} if s.error else {}))
+
+
+def has_trace_context() -> bool:
+    """Whether this context carries an EXPLICIT trace identity — a
+    bound per-build registry or an open span. Build-submission paths
+    (``WorkerClient.build``) attach a ``traceparent`` only then: the
+    process-global registry's id is fine for attributing stray HTTP,
+    but adopting it for a build would merge every build a bare
+    process submits into one trace (and two concurrent submissions
+    into each other's)."""
+    return (_build_registry.get() is not None
+            or _current_span.get() is not None)
 
 
 def current_traceparent() -> str:
@@ -661,6 +770,86 @@ def render_prometheus(registry: MetricsRegistry | None = None) -> str:
                              f"{_fmt_value(h.sum)}")
                 lines.append(f"{name}_count{_fmt_labels(key)} {h.count}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def relabel_prometheus(text: str, **labels: str) -> str:
+    """Inject labels into every sample line of a Prometheus text
+    exposition — how the fleet front door re-exports each worker's
+    scrape under a ``worker="wN"`` label so one Prometheus target sees
+    the whole fleet. Comment/TYPE lines pass through unchanged; sample
+    lines gain the labels FIRST (`name{worker="w0",...} value`), both
+    the brace-less and labeled forms. Injected labels are
+    operator-controlled (worker ids), so no escaping beyond the
+    standard one is needed."""
+    if not labels:
+        return text
+    inject = ",".join(f'{k}="{_escape(str(v))}"'
+                      for k, v in sorted(labels.items()))
+    out: list[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        name, sep, rest = line.partition("{")
+        if sep:
+            out.append(f"{name}{{{inject},{rest}")
+        else:
+            name, _, value = line.partition(" ")
+            out.append(f"{name}{{{inject}}} {value}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merge_prometheus(parts: list[str]) -> str:
+    """Merge several Prometheus text expositions into ONE valid one:
+    all samples of a metric family end up in a single group under a
+    single ``# TYPE`` line (the format forbids split groups — naive
+    concatenation of N scrapes is exactly that). Histogram samples
+    (``_bucket``/``_sum``/``_count``) fold into their declared family.
+    First TYPE declaration wins; family order is first-seen."""
+    order: list[str] = []
+    type_line: dict[str, str] = {}
+    samples: dict[str, list[str]] = {}
+    histograms: set[str] = set()
+    # Pass 1: every declared histogram family (so pass 2 can fold
+    # suffixed samples even when they appear before/without their own
+    # part's TYPE line).
+    for text in parts:
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                fields = line.split()
+                if len(fields) >= 4 and fields[3] == "histogram":
+                    histograms.add(fields[2])
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) \
+                    and name[:-len(suffix)] in histograms:
+                return name[:-len(suffix)]
+        return name
+
+    for text in parts:
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                fields = line.split()
+                if len(fields) >= 3:
+                    type_line.setdefault(fields[2], line)
+                continue
+            if line.startswith("#"):
+                continue
+            name = line.partition("{")[0].partition(" ")[0]
+            family = family_of(name)
+            if family not in samples:
+                samples[family] = []
+                order.append(family)
+            samples[family].append(line)
+    out: list[str] = []
+    for family in order:
+        if family in type_line:
+            out.append(type_line[family])
+        out.extend(samples[family])
+    return "\n".join(out) + ("\n" if out else "")
 
 
 def summary(registry: MetricsRegistry | None = None) -> dict[str, Any]:

@@ -429,9 +429,19 @@ class WorkerClient:
             argv = list(argv) + [worker_ctx]
         self.last_build = {}  # stale outcome must not survive a retry
         self.last_events = []
-        conn, resp = self._request("POST", "/build",
-                                   json.dumps(argv).encode(),
-                                   tenant=tenant)
+        # The caller's current trace context rides along so the
+        # worker-side build ADOPTS it (one trace id across client and
+        # worker — loadgen/bench/fleet stitch for free). Only when the
+        # caller HAS an explicit context (bound registry or open
+        # span): attaching the process-global fallback id would merge
+        # every build a bare process submits into one trace.
+        from makisu_tpu.utils import metrics
+        headers = {}
+        if metrics.has_trace_context():
+            headers["traceparent"] = metrics.current_traceparent()
+        conn, resp = self._request(
+            "POST", "/build", json.dumps(argv).encode(),
+            tenant=tenant, headers=headers)
         build_code = 1
         try:
             if resp.status != 200:

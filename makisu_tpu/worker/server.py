@@ -314,21 +314,32 @@ class _Handler(BaseHTTPRequestHandler):
             from makisu_tpu.serve import server as serve_server
             serve_server.handle_recipe(
                 self, self.path[len("/recipes/"):],
-                roots=self.server.served_chunk_roots())
+                roots=self.server.served_chunk_roots(),
+                access=self.server.serve_access)
         elif self.path.startswith("/packs/"):
             # Ranged pack serving: spans synthesized from the chunk
             # CAS, streamed under the transfer memory budget.
             from makisu_tpu.serve import server as serve_server
             serve_server.handle_pack(
                 self, self.path[len("/packs/"):],
-                roots=self.server.served_chunk_roots())
+                roots=self.server.served_chunk_roots(),
+                access=self.server.serve_access)
         elif self.path.startswith("/zpacks/"):
             # Seekable twin: ranged COMPRESSED frames of the same
             # packs (404 routes frame-less packs to /packs).
             from makisu_tpu.serve import server as serve_server
             serve_server.handle_zpack(
                 self, self.path[len("/zpacks/"):],
-                roots=self.server.served_chunk_roots())
+                roots=self.server.served_chunk_roots(),
+                access=self.server.serve_access)
+        elif self.path == "/serve/access":
+            # This worker's serve access ledger: every peer/delta
+            # fetch it answered, stamped with the requesting build's
+            # trace id — the server-side half of a stitched fleet
+            # trace.
+            self._respond(200, json.dumps({
+                "entries": self.server.serve_access.snapshot(),
+            }).encode(), content_type="application/json")
         elif self.path == "/peers":
             from makisu_tpu.fleet import peers as fleet_peers
             self._respond(200, json.dumps({
@@ -350,16 +361,20 @@ class _Handler(BaseHTTPRequestHandler):
         touches any path machinery — this endpoint fronts a CAS whose
         keys become file paths."""
         from makisu_tpu.cache import chunks as chunks_mod
+        from makisu_tpu.serve import server as serve_server
         from makisu_tpu.utils import metrics
         if len(name) != 64 or any(c not in "0123456789abcdef"
                                   for c in name):
             self._respond(400, b"bad chunk fingerprint")
             return
+        access = self.server.serve_access
         fh = chunks_mod.open_served_chunk(
             name, roots=self.server.served_chunk_roots())
         if fh is None:
             metrics.global_registry().counter_add(
                 metrics.FLEET_CHUNK_SERVES, result="miss")
+            access.record("chunk", name, 404, 0,
+                          serve_server.inbound_trace_id(self))
             self._respond(404, b"chunk not held here")
             return
         try:
@@ -369,6 +384,8 @@ class _Handler(BaseHTTPRequestHandler):
                 metrics.FLEET_CHUNK_SERVES, result="hit")
             metrics.global_registry().counter_add(
                 metrics.FLEET_CHUNK_SERVE_BYTES, len(data))
+            access.record("chunk", name, 200, len(data),
+                          serve_server.inbound_trace_id(self))
             self._respond(200, data,
                           content_type="application/octet-stream")
         except OSError:
@@ -429,12 +446,22 @@ class _Handler(BaseHTTPRequestHandler):
         # when both name a tenant (proxies inject headers; bodies come
         # from the original submitter).
         tenant = ""
+        traceparent = ""
+        fleet_info = None
         if isinstance(body, dict):
             argv = body.get("argv") or []
             tenant = str(body.get("tenant") or "")
+            traceparent = str(body.get("traceparent") or "")
+            if isinstance(body.get("fleet"), dict):
+                fleet_info = body["fleet"]
         else:
             argv = body
         tenant = self.headers.get("X-Makisu-Tenant") or tenant
+        # Header wins over the body field (same precedence as the
+        # tenant): proxies inject headers, bodies come from the
+        # original submitter. Validation happens at adoption time —
+        # a malformed value mints fresh ids, never a 400.
+        traceparent = self.headers.get("traceparent") or traceparent
         if not isinstance(argv, list) or not all(
                 isinstance(a, str) for a in argv):
             self._respond(400, b"bad argv json")
@@ -479,7 +506,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         start = time.monotonic()
         record = self.server.register_build(argv, tenant)
-        code = self.server.run_build(argv, emit, record)
+        code = self.server.run_build(argv, emit, record,
+                                     traceparent=traceparent,
+                                     fleet_info=fleet_info)
         # Terminal line carries the outcome as DATA — exit code,
         # elapsed seconds, and the admission split (queue wait vs
         # execution) — so clients never parse log text for it.
@@ -650,6 +679,11 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # /packs answer for fleet peers and delta-pull clients.
         from makisu_tpu.serve import server as serve_server
         serve_server.enable_publishing()
+        # This worker's serve access ledger (GET /serve/access): every
+        # peer/delta fetch answered here, stamped with the requesting
+        # build's trace id. Per server — an in-process sibling's
+        # traffic must not appear in this worker's ledger.
+        self.serve_access = serve_server.AccessLog()
         # Chunk CAS roots THIS server's builds have used: the /chunks
         # peer endpoint serves only these (the process-wide registry
         # would also hold in-process siblings' stores, and serving a
@@ -801,7 +835,9 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         }
 
     def run_build(self, argv: list[str], emit,
-                  record: _BuildRecord | None = None) -> int:
+                  record: _BuildRecord | None = None,
+                  traceparent: str = "",
+                  fleet_info: dict | None = None) -> int:
         """Run one build command in-process, forwarding log lines and
         build events.
 
@@ -836,6 +872,39 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             record = self.register_build(argv)
         queue_wait = self._admission.acquire()
         record.start_running(queue_wait)
+        # Inbound trace context: bound for cli.main to adopt into the
+        # build's registry (the build's spans, events, and outbound
+        # traceparents all join the caller's trace). Parsed here too so
+        # the queue-wait emission below can be stamped with the right
+        # ids even though it precedes the registry's existence.
+        trace_token = metrics.bind_inbound_traceparent(traceparent)
+        parsed_tp = (metrics.parse_traceparent(traceparent)
+                     if traceparent else None)
+        # Fleet provenance: when the front door forwarded this build,
+        # the routing outcome rides into the build's history record
+        # (utils/history.py reads the contextvar at append time).
+        from makisu_tpu.utils import history as history_mod
+        fleet_token = None
+        if fleet_info is not None:
+            try:
+                provenance = {
+                    # The front door's scheduler-assigned id when it
+                    # sent one (how every other fleet surface names
+                    # workers); the socket path only as the fallback
+                    # for non-fleet callers that pass a fleet dict.
+                    "worker": str(fleet_info.get("worker", "")
+                                  or self.socket_path),
+                    "verdict": str(fleet_info.get("verdict", "")),
+                    "attempts": int(fleet_info.get("attempts", 1) or 1),
+                    "quota_wait_seconds": float(
+                        fleet_info.get("quota_wait_seconds", 0.0)
+                        or 0.0),
+                }
+            except (TypeError, ValueError):
+                # A client-supplied junk "fleet" dict degrades to bare
+                # via-a-front-door provenance, never a failed build.
+                provenance = {"worker": self.socket_path}
+            fleet_token = history_mod.bind_fleet_provenance(provenance)
         # The sink honors this build's own --log-level (the shared
         # console logger's level is process-global and can't).
         flags = _effective_flags(argv)
@@ -855,6 +924,16 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         from makisu_tpu.worker import session as session_mod
         session_token = session_mod.bind_manager(self.session_mgr)
         peers_token = fleet_peers.bind_self_socket(self.socket_path)
+        # The admission wait as a first-class trace event: it happened
+        # BEFORE the build's registry existed, so it is emitted here —
+        # now that the stream/record sinks are bound — stamped with the
+        # inbound trace ids. The merged fleet trace synthesizes it into
+        # a queue_wait span beside the front door's quota wait.
+        events.emit("queue_wait", seconds=round(queue_wait, 6),
+                    tenant=record.tenant or "",
+                    **({"trace_id": parsed_tp[0],
+                        "parent_id": parsed_tp[1]}
+                       if parsed_tp else {}))
         # Count the build started BEFORE acquiring shared-path locks:
         # a build wedged waiting on another build's --root/--storage
         # must show as active in /healthz — that is the situation the
@@ -905,6 +984,9 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             self._retire_build(record, code)
             fleet_peers.reset_self_socket(peers_token)
             session_mod.reset_manager(session_token)
+            if fleet_token is not None:
+                history_mod.reset_fleet_provenance(fleet_token)
+            metrics.reset_inbound_traceparent(trace_token)
             cli.invocation_mode.reset(mode_token)
             events.reset_sink(record_token)
             events.reset_sink(events_token)

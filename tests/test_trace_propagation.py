@@ -4,8 +4,10 @@ header whose trace id is the build's own, so server-side access logs
 correlate with the build's span tree and trace export."""
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
@@ -210,3 +212,823 @@ def test_build_requests_carry_build_trace_id(tmp_path, kv_server):
     for method, path, header in kv_server.requests:
         assert trace_id_of(header) == trace_id, \
             f"KV {method} {path} carried foreign/absent trace {header!r}"
+
+
+# -- traceparent parse / adopt / reject ------------------------------------
+
+
+def test_parse_traceparent_matrix():
+    good = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert metrics.parse_traceparent(good) == ("ab" * 16, "cd" * 8)
+    # Unknown (but well-formed) versions parse; ff is reserved-invalid.
+    assert metrics.parse_traceparent("07-" + "ab" * 16 + "-"
+                                     + "cd" * 8 + "-00") is not None
+    bad = [
+        "",                                              # empty
+        "garbage",                                       # no fields
+        "00-" + "ab" * 16 + "-" + "cd" * 8,              # 3 fields
+        "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",      # uppercase
+        "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",      # short trace
+        "00-" + "ab" * 16 + "-" + "cd" * 7 + "-01",      # short span
+        "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",      # zero trace
+        "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",      # zero span
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",      # version ff
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",      # non-hex
+        None,
+    ]
+    for value in bad:
+        assert metrics.parse_traceparent(value) is None, value
+
+
+def test_registry_adopt_trace():
+    reg = metrics.MetricsRegistry()
+    reg.adopt_trace("ab" * 16, "cd" * 8)
+    assert reg.trace_id == "ab" * 16
+    assert reg.root.span_id == "cd" * 8
+    token = metrics.set_build_registry(reg)
+    try:
+        # No open span: the header names the ADOPTED parent span, so
+        # outbound requests chain under the upstream caller.
+        assert metrics.current_traceparent() == \
+            "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        with metrics.span("child") as s:
+            assert s.parent_id == "cd" * 8
+    finally:
+        metrics.reset_build_registry(token)
+
+
+def test_span_events_carry_trace_id():
+    from makisu_tpu.utils import events
+    reg = metrics.MetricsRegistry()
+    seen = []
+    reg_token = metrics.set_build_registry(reg)
+    sink_token = events.add_sink(seen.append)
+    try:
+        with metrics.span("traced"):
+            pass
+    finally:
+        events.reset_sink(sink_token)
+        metrics.reset_build_registry(reg_token)
+    kinds = {e["type"]: e for e in seen}
+    assert kinds["span_start"]["trace_id"] == reg.trace_id
+    assert kinds["span_end"]["trace_id"] == reg.trace_id
+
+
+# -- prometheus relabel / merge --------------------------------------------
+
+
+def test_relabel_and_merge_prometheus():
+    a = ("# TYPE m_total counter\n"
+         'm_total{k="v"} 3\n'
+         "m_total 1\n"
+         "# TYPE h histogram\n"
+         'h_bucket{le="1"} 2\n'
+         "h_sum 1.5\n"
+         "h_count 2\n")
+    relabeled = metrics.relabel_prometheus(a, worker="w1")
+    assert 'm_total{worker="w1",k="v"} 3' in relabeled
+    assert 'm_total{worker="w1"} 1' in relabeled
+    assert 'h_bucket{worker="w1",le="1"} 2' in relabeled
+    merged = metrics.merge_prometheus([a, relabeled])
+    lines = merged.splitlines()
+    # One TYPE line per family, every family's samples in ONE group.
+    assert lines.count("# TYPE m_total counter") == 1
+    assert lines.count("# TYPE h histogram") == 1
+    m_rows = [i for i, ln in enumerate(lines)
+              if ln.startswith("m_total")]
+    assert m_rows == list(range(m_rows[0], m_rows[0] + len(m_rows)))
+    h_rows = [i for i, ln in enumerate(lines)
+              if ln.startswith("h_")]
+    assert h_rows == list(range(h_rows[0], h_rows[0] + len(h_rows)))
+    assert 'h_sum{worker="w1"} 1.5' in merged
+
+
+# -- worker adoption --------------------------------------------------------
+
+
+@pytest.fixture
+def trace_worker(tmp_path):
+    from makisu_tpu.worker import WorkerServer
+    server = WorkerServer(str(tmp_path / "tw.sock"))
+    server.serve_background()
+    yield server
+    server.shutdown()
+    server.server_close()
+
+
+def test_worker_build_adopts_caller_trace(tmp_path, trace_worker):
+    """A build submitted through WorkerClient joins the CALLER's
+    trace: every span event the worker streams back carries the
+    caller's trace id, and the worker's top build span chains under
+    the caller's span."""
+    from makisu_tpu.worker import WorkerClient
+    ctx = tmp_path / "actx"
+    ctx.mkdir()
+    (ctx / "Dockerfile").write_text(
+        "FROM scratch\nCOPY d.txt /d.txt\n")
+    (ctx / "d.txt").write_text("adopt me")
+    (tmp_path / "aroot").mkdir()
+    reg = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg)
+    try:
+        client = WorkerClient(trace_worker.socket_path)
+        code = client.build([
+            "--log-level", "error",
+            "build", str(ctx), "-t", "trace/adopt:1",
+            "--storage", str(tmp_path / "astorage"),
+            "--root", str(tmp_path / "aroot"),
+        ])
+    finally:
+        metrics.reset_build_registry(token)
+    assert code == 0
+    events_by_type = {}
+    for event in client.last_events:
+        events_by_type.setdefault(event["type"], []).append(event)
+    [start] = events_by_type["build_start"]
+    assert start["trace_id"] == reg.trace_id
+    # The admission wait rode the stream stamped with the same trace,
+    # parented on the caller's span (root: no span was open).
+    [wait] = events_by_type["queue_wait"]
+    assert wait["trace_id"] == reg.trace_id
+    assert wait["parent_id"] == reg.root.span_id
+    for span_event in events_by_type["span_start"]:
+        assert span_event["trace_id"] == reg.trace_id
+    # The worker's TOP span chains under the caller's span id.
+    tops = [e for e in events_by_type["span_start"]
+            if e["parent_id"] == reg.root.span_id]
+    assert tops and tops[0]["name"] == "build"
+    # Adoption counted.
+    assert metrics.global_registry().counter_total(
+        metrics.TRACE_ADOPTED, result="adopted") >= 1
+
+
+def test_worker_malformed_traceparent_mints_fresh(tmp_path,
+                                                  trace_worker):
+    """A garbage traceparent header must never crash the request —
+    the worker mints fresh ids and counts the rejection."""
+    import http.client as http_client
+
+    from makisu_tpu.worker.client import (
+        _UnixHTTPConnection,
+        iter_stream_lines,
+    )
+    g = metrics.global_registry()
+    before = g.counter_total(metrics.TRACE_ADOPTED,
+                             result="malformed")
+    # A cheap command that still runs the full invocation lifecycle
+    # (build_start/build_end events, registry creation — the adoption
+    # point under test).
+    report_path = tmp_path / "empty-report.json"
+    report_path.write_text(json.dumps(
+        {"schema": "makisu-tpu.metrics.v1", "trace_id": "",
+         "spans": [], "counters": {}, "gauges": {},
+         "histograms": {}}))
+    conn = _UnixHTTPConnection(trace_worker.socket_path, 60.0)
+    try:
+        conn.request("POST", "/build",
+                     body=json.dumps(
+                         ["report", str(report_path)]).encode(),
+                     headers={"Content-Type": "application/json",
+                              "traceparent": "not-a-traceparent"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        frames = [json.loads(line)
+                  for line in iter_stream_lines(resp)]
+    finally:
+        conn.close()
+    terminal = [f for f in frames if "build_code" in f]
+    assert terminal and terminal[0]["exit_code"] == 0
+    starts = [f["event"] for f in frames
+              if f.get("event", {}).get("type") == "build_start"]
+    assert starts
+    assert re.fullmatch(r"[0-9a-f]{32}", starts[0]["trace_id"])
+    assert g.counter_total(metrics.TRACE_ADOPTED,
+                           result="malformed") == before + 1
+
+
+# -- fleet: one trace id from front door to chunk wire ----------------------
+
+
+class _TraceFleet:
+    """2 in-process workers (own storage each) behind a FleetServer,
+    plus a shared KV — the minimal topology where affinity, drain-
+    forced relocation, and the peer chunk wire all happen."""
+
+    def __init__(self, tmp_path, n=2):
+        from makisu_tpu.fleet import FleetServer, WorkerSpec
+        from makisu_tpu.fleet.kv import SharedKVServer
+        from makisu_tpu.worker import WorkerClient, WorkerServer
+        self.kv = SharedKVServer()
+        self.kv_addr = self.kv.start()
+        self.workers = {}
+        specs = []
+        for i in range(n):
+            wid = f"w{i}"
+            server = WorkerServer(str(tmp_path / f"{wid}.sock"))
+            server.serve_background()
+            self.workers[wid] = server
+            specs.append(WorkerSpec(
+                wid, server.socket_path,
+                str(tmp_path / f"{wid}-storage")))
+        self.specs = {s.id: s for s in specs}
+        self.server = FleetServer(str(tmp_path / "fleet.sock"),
+                                  specs, poll_interval=0.2)
+        self.server.serve_background()
+        self.client = WorkerClient(self.server.socket_path)
+        deadline = time.monotonic() + 30
+        while not self.client.ready():
+            assert time.monotonic() < deadline, "fleet never ready"
+            time.sleep(0.05)
+
+    def drain(self, worker_id):
+        from makisu_tpu.worker.client import _UnixHTTPConnection
+        conn = _UnixHTTPConnection(self.server.socket_path, 10.0)
+        try:
+            conn.request("POST", "/drain", body=json.dumps(
+                {"worker": worker_id}).encode())
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+        deadline = time.monotonic() + 10
+        while True:
+            workers = {w["id"]: w for w in
+                       self.client.healthz()["fleet"]["workers"]}
+            if workers[worker_id]["state"] == "draining":
+                return
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        for server in self.workers.values():
+            server.shutdown()
+            server.server_close()
+        self.kv.stop()
+
+
+@pytest.fixture
+def trace_fleet(tmp_path):
+    from makisu_tpu.fleet import peers as fleet_peers
+    fleet_peers.reset()
+    fleet = _TraceFleet(tmp_path)
+    yield fleet
+    fleet.close()
+    fleet_peers.reset()
+
+
+def _fleet_ctx(tmp_path, name="tctx"):
+    ctx = tmp_path / name
+    (ctx / "src").mkdir(parents=True)
+    (ctx / "Dockerfile").write_text("FROM scratch\nCOPY src/ /src/\n")
+    for i in range(4):
+        (ctx / "src" / f"m{i}.py").write_text(
+            f"# {name} {i}\n" + "x=1\n" * 120)
+    (tmp_path / "root").mkdir(exist_ok=True)
+    return ctx
+
+
+def _walk_named(span, name):
+    out = []
+    stack = [span]
+    while stack:
+        s = stack.pop()
+        if s.get("name") == name:
+            out.append(s)
+        stack.extend(s.get("children", []))
+    return out
+
+
+def test_fleet_single_trace_end_to_end(tmp_path, trace_fleet):
+    """The acceptance path: a build routed through a 2-worker fleet
+    carries ONE trace id across the front door's admit/route/forward
+    spans, the worker's queue wait + build spans, the serving worker's
+    access ledger (after a drain-forced relocation peer-fetches the
+    chunks), the history record's fleet provenance, and the merged
+    Perfetto assembly — whose critical path starts at the front-door
+    wall-time root."""
+    from makisu_tpu.utils import history as history_mod
+    from makisu_tpu.utils import traceexport
+    import time as time_mod
+
+    ctx = _fleet_ctx(tmp_path)
+    hist_path = tmp_path / "history.jsonl"
+    argv = ["--log-level", "error",
+            "--history-out", str(hist_path),
+            "build", str(ctx), "-t", "trace/fleet:1",
+            "--hasher", "tpu", "--root", str(tmp_path / "root"),
+            "--http-cache-addr", trace_fleet.kv_addr]
+
+    # Build 1: lands somewhere, minting the session + chunk CAS there.
+    reg1 = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg1)
+    try:
+        assert trace_fleet.client.build(argv, tenant="team-a") == 0
+    finally:
+        metrics.reset_build_registry(token)
+    first = dict(trace_fleet.client.last_build)
+    assert first["trace_id"] == reg1.trace_id
+    holder = first["worker"]
+
+    # Drain the holder: build 2 relocates and peer-fetches its chunks
+    # from the holder over the serve plane.
+    trace_fleet.drain(holder)
+    reg2 = metrics.MetricsRegistry()
+    token = metrics.set_build_registry(reg2)
+    try:
+        assert trace_fleet.client.build(argv, tenant="team-a") == 0
+    finally:
+        metrics.reset_build_registry(token)
+    second = dict(trace_fleet.client.last_build)
+    assert second["worker"] != holder
+    assert second["trace_id"] == reg2.trace_id
+
+    # Worker-side: every event of build 2 carries the caller's trace.
+    events2 = trace_fleet.client.last_events
+    starts = [e for e in events2 if e["type"] == "build_start"
+              and e.get("command") != "fleet_build"]
+    assert starts and starts[-1]["trace_id"] == reg2.trace_id
+    # Serving-side: the drained holder's access ledger recorded the
+    # peer fetches under the SAME trace id.
+    access = trace_fleet.workers[holder].serve_access.snapshot()
+    traced = [row for row in access
+              if row["trace_id"] == reg2.trace_id]
+    assert traced, f"no access rows for trace {reg2.trace_id}: " \
+                   f"{access}"
+    # The BULK rows must correlate, not just the recipe lookup: the
+    # ranged pack/zpack (or fallback chunk) fetches that moved the
+    # actual bytes carry the build's traceparent too.
+    assert any(row["kind"] in ("pack", "zpack", "chunk")
+               and row["status"] in (200, 206) and row["bytes"] > 0
+               for row in traced), traced
+    # History: the record carries fleet provenance.
+    records = history_mod.read_history(str(hist_path))
+    assert len(records) == 2
+    assert records[-1]["trace_id"] == reg2.trace_id
+    fleet_prov = records[-1]["fleet"]
+    # The scheduler-assigned id, same namespace as every other fleet
+    # surface (terminal frames, top, doctor, report --fleet).
+    assert fleet_prov["worker"] == second["worker"]
+    assert fleet_prov["verdict"] == second["fleet_verdict"]
+
+    # Merged assembly from the front door's collector.
+    assembled = traceexport.assemble_fleet_trace(
+        trace_fleet.server.trace_events())
+    by_id = {t["trace_id"]: t for t in assembled["traces"]}
+    assert reg1.trace_id in by_id and reg2.trace_id in by_id
+    trace2 = by_id[reg2.trace_id]
+    report_shape = {"spans": trace2["spans"]}
+    top = traceexport.root_span(report_shape)
+    assert top["name"] == "fleet_build"
+    # Cross-process nesting: the worker's build span sits under a
+    # fleet_forward span, and its queue wait beside it.
+    [forward] = _walk_named(top, "fleet_forward")
+    builds = _walk_named(forward, "build")
+    assert builds, "worker build span did not nest under the forward"
+    assert builds[0]["trace_id"] == reg2.trace_id
+    assert _walk_named(forward, "queue_wait")
+    # Critical path: starts at the front-door root, totals its wall.
+    path = traceexport.critical_path(report_shape)
+    assert path[0]["name"] == "fleet_build"
+    assert abs(path[0]["duration"]
+               - (top["duration"] or 0.0)) < 1e-9
+    # Perfetto export: one process track per side of the stitch.
+    perfetto = traceexport.fleet_perfetto_trace(assembled)
+    process_names = {e["args"]["name"]
+                     for e in perfetto["traceEvents"]
+                     if e.get("name") == "process_name"}
+    assert "makisu-tpu fleet front door" in process_names
+    assert any(name.startswith("worker ") for name in process_names)
+    # The human report renders both waits and the path.
+    rendered = traceexport.render_fleet_report(assembled)
+    assert "front-door quota wait" in rendered
+    assert "worker queue wait" in rendered
+    assert reg2.trace_id in rendered
+
+
+class _RefusingWorker:
+    """A fake worker that polls healthy, claims a resident session
+    for one context (so affinity routes to it first), and refuses
+    every build with 503 — the deterministic failover trigger."""
+
+    def __init__(self, socket_path, session_context):
+        import socketserver
+        from http.server import BaseHTTPRequestHandler
+        ctx = session_context
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, payload, status=200):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    self._json({"ok": True})
+                elif self.path == "/healthz":
+                    self._json({
+                        "status": "ok", "uptime_seconds": 1.0,
+                        "builds_started": 0, "builds_succeeded": 0,
+                        "builds_failed": 0, "active_builds": 0,
+                        "queue": {"depth": 0,
+                                  "max_concurrent_builds": 0,
+                                  "wait_seconds": {},
+                                  "latency_seconds": {},
+                                  "tenant_latency_seconds": {}},
+                        "serve": {}, "peer_map_version": 0,
+                        "last_progress_seconds": 0.0,
+                    })
+                elif self.path == "/sessions":
+                    self._json({"sessions": [{"context": ctx}],
+                                "hits": 1})
+                else:
+                    self._json({"error": "nope"}, status=404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                if self.path == "/peers":
+                    self._json({"applied": True, "version": 1})
+                else:
+                    self._json({"error": "admission_refused"},
+                               status=503)
+
+        class Server(socketserver.ThreadingMixIn,
+                     socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+            def get_request(self):
+                request, _ = super().get_request()
+                return request, ("refuser", 0)
+
+        import os as os_mod
+        if os_mod.path.exists(socket_path):
+            os_mod.unlink(socket_path)
+        self.server = Server(socket_path, Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_fleet_failover_attempts_share_one_trace(tmp_path):
+    """A build whose first worker refuses shows BOTH attempts as
+    sibling fleet_forward subtrees under ONE fleet_build span — the
+    failover story is one trace, not two."""
+    import time as time_mod
+
+    from makisu_tpu.fleet import FleetServer, WorkerSpec
+    from makisu_tpu.fleet import peers as fleet_peers
+    from makisu_tpu.utils import traceexport
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+    fleet_peers.reset()
+    ctx = _fleet_ctx(tmp_path, "fctx")
+    refuser = _RefusingWorker(str(tmp_path / "refuser.sock"),
+                              os.path.realpath(str(ctx)))
+    real = WorkerServer(str(tmp_path / "real.sock"))
+    real.serve_background()
+    fleet = FleetServer(
+        str(tmp_path / "ffleet.sock"),
+        [WorkerSpec("refuser", str(tmp_path / "refuser.sock"),
+                    str(tmp_path / "r-storage")),
+         WorkerSpec("real", real.socket_path,
+                    str(tmp_path / "real-storage"))],
+        poll_interval=0.2)
+    fleet.serve_background()
+    client = WorkerClient(fleet.socket_path)
+    try:
+        deadline = time_mod.monotonic() + 30
+        while True:
+            if client.ready():
+                workers = {w["id"]: w for w in
+                           client.healthz()["fleet"]["workers"]}
+                if all(w["alive"] for w in workers.values()):
+                    break
+            assert time_mod.monotonic() < deadline, "never ready"
+            time_mod.sleep(0.05)
+        reg = metrics.MetricsRegistry()
+        token = metrics.set_build_registry(reg)
+        try:
+            code = client.build(
+                ["--log-level", "error", "build", str(ctx),
+                 "-t", "trace/failover:1",
+                 "--root", str(tmp_path / "root")],
+                tenant="t")
+        finally:
+            metrics.reset_build_registry(token)
+        assert code == 0
+        terminal = dict(client.last_build)
+        assert terminal["fleet_attempts"] == 2
+        assert terminal["worker"] == "real"
+        assert terminal["trace_id"] == reg.trace_id
+        assembled = traceexport.assemble_fleet_trace(
+            fleet.trace_events())
+        trace = {t["trace_id"]: t
+                 for t in assembled["traces"]}[reg.trace_id]
+        top = traceexport.root_span({"spans": trace["spans"]})
+        assert top["name"] == "fleet_build"
+        forwards = _walk_named(top, "fleet_forward")
+        assert len(forwards) == 2
+        attempts = {f["attrs"]["worker"]: int(f["attrs"]["attempt"])
+                    for f in forwards}
+        assert attempts == {"refuser": 0, "real": 1}
+        # Only the second attempt grew a worker build subtree.
+        assert not _walk_named(
+            [f for f in forwards
+             if f["attrs"]["worker"] == "refuser"][0], "build")
+        assert _walk_named(
+            [f for f in forwards
+             if f["attrs"]["worker"] == "real"][0], "build")
+    finally:
+        fleet.shutdown()
+        fleet.server_close()
+        real.shutdown()
+        real.server_close()
+        refuser.close()
+        fleet_peers.reset()
+
+
+def test_fleet_aggregated_metrics_scrape(trace_fleet):
+    """Fleet GET /metrics re-exports every worker's scrape under a
+    worker label beside the front door's own series, as ONE valid
+    exposition (single TYPE line / single group per family)."""
+    text = trace_fleet.client.metrics()
+    assert 'worker="w0"' in text
+    assert 'worker="w1"' in text
+    # The front door's own series carry no worker label.
+    assert re.search(r"^makisu_fleet_workers\{state=\"alive\"\} ",
+                     text, re.M)
+    # One TYPE line per family even though three expositions merged.
+    types = [ln for ln in text.splitlines()
+             if ln.startswith("# TYPE ")]
+    assert len(types) == len(set(types))
+    assert metrics.global_registry().counter_total(
+        metrics.FLEET_AGGREGATED_SCRAPES, result="ok") >= 2
+
+
+def test_fleet_healthz_self_section(trace_fleet):
+    health = trace_fleet.client.healthz()
+    self_section = health["self"]
+    assert self_section["peer_map"]["version"] >= 1
+    # Both workers acked the current map.
+    assert set(self_section["peer_map"]["acked"]) == {"w0", "w1"}
+    assert self_section["peer_map"]["stale_acks"] == []
+    assert "decision_ring" in self_section
+    assert self_section["oldest_poll_age_seconds"] is not None
+    assert "last_progress_seconds" in health
+
+
+def test_fleet_doctor_names_dead_worker_and_drift(trace_fleet,
+                                                  capsys):
+    """Kill a worker outright: ``doctor --fleet SOCKET`` must name it
+    DEAD. (Stale peer-map acks and quota pinning are covered by the
+    canned-payload unit below — deterministically.)"""
+    import time as time_mod
+
+    from makisu_tpu import cli
+    victim = trace_fleet.workers["w1"]
+    victim.shutdown()
+    victim.server_close()
+    deadline = time_mod.monotonic() + 15
+    while True:
+        workers = {w["id"]: w for w in
+                   trace_fleet.client.healthz()["fleet"]["workers"]}
+        if not workers["w1"]["alive"]:
+            break
+        assert time_mod.monotonic() < deadline
+        time_mod.sleep(0.05)
+    code = cli.main(["doctor", "--fleet",
+                     trace_fleet.server.socket_path])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert "worker w1 is DEAD" in out
+    assert "diagnosis" in out
+
+
+def test_fleet_doctor_canned_findings():
+    from makisu_tpu.fleet.doctor import (
+        diagnose_fleet,
+        render_fleet_doctor,
+    )
+    health = {
+        "status": "ok", "uptime_seconds": 10.0, "active_builds": 1,
+        "last_progress_seconds": 0.5,
+        "fleet": {
+            "workers": [
+                {"id": "w0", "alive": True, "draining": False,
+                 "state": "alive", "sessions": ["/ctx/a"],
+                 "active_builds": 1, "queue_depth": 0,
+                 "last_poll_age_seconds": 0.2,
+                 "consecutive_failures": 0, "last_error": ""},
+                {"id": "w1", "alive": False, "draining": False,
+                 "state": "dead", "sessions": [],
+                 "active_builds": 0, "queue_depth": 0,
+                 "last_poll_age_seconds": 4.0,
+                 "consecutive_failures": 7,
+                 "last_error": "connection refused"},
+                {"id": "w2", "alive": True, "draining": True,
+                 "state": "draining", "sessions": [],
+                 "active_builds": 2, "queue_depth": 0,
+                 "last_poll_age_seconds": 0.2,
+                 "consecutive_failures": 0, "last_error": ""},
+            ],
+            "tenant_quota": 2,
+            "tenants": {"team-a": {"inflight": 2, "quota": 2}},
+            "frontdoor_waiting": 3,
+            "placements": {"/ctx/a": "w1", "/ctx/b": "w2"},
+            "peer_map_version": 9,
+        },
+        "self": {
+            "poll_interval_seconds": 0.2,
+            "oldest_poll_age_seconds": 4.0,
+            "peer_map": {"version": 9,
+                         "acked": {"w0": 9, "w2": 7},
+                         "stale_acks": ["w2"]},
+            "decision_ring": {"size": 12,
+                              "verdicts": {"affinity": 9,
+                                           "failover": 3}},
+            "last_progress_seconds": 0.5,
+            "watchdog_armed": True,
+        },
+    }
+    findings = diagnose_fleet(health)
+    kinds = {f["kind"] for f in findings}
+    assert kinds >= {"dead_worker", "draining_worker",
+                     "stale_peer_map", "quota_pinned",
+                     "placement_drift"}
+    # Severity ordering: errors first.
+    assert findings[0]["severity"] == "error"
+    stale = [f for f in findings if f["kind"] == "stale_peer_map"]
+    assert len(stale) == 1 and stale[0]["worker"] == "w2"
+    rendered = render_fleet_doctor(health, "/tmp/fleet.sock")
+    assert "worker w1 is DEAD" in rendered
+    assert "stale" in rendered or "acked peer map" in rendered
+    assert "pinned at its quota" in rendered
+    assert "placement memo pins" in rendered
+
+
+def test_history_routing_mix_diff():
+    from makisu_tpu.utils import history as history_mod
+    direct = [{"schema": history_mod.HISTORY_SCHEMA, "ts": float(i),
+               "duration_seconds": 1.0, "exit_code": 0,
+               "cache": {"hits": 1, "misses": 1}}
+              for i in range(4)]
+    routed = [{"schema": history_mod.HISTORY_SCHEMA,
+               "ts": 10.0 + i, "duration_seconds": 1.0,
+               "exit_code": 0, "cache": {"hits": 1, "misses": 1},
+               "fleet": {"worker": "/run/w0.sock",
+                         "verdict": "affinity", "attempts": 1,
+                         "quota_wait_seconds": 0.0}}
+              for i in range(4)]
+    agg_direct = history_mod.aggregate(direct)
+    agg_routed = history_mod.aggregate(routed)
+    assert agg_direct["routing"] == "direct"
+    assert agg_routed["routing"] == "fleet"
+    assert agg_routed["dominant_worker"] == "/run/w0.sock"
+    result = history_mod.diff(direct, routed)
+    change = result["routing_change"]
+    assert change["baseline"] == "direct"
+    assert change["candidate"] == "fleet"
+    assert change["candidate_worker"] == "/run/w0.sock"
+    rendered = history_mod.render_diff(result)
+    assert "routing mix: direct → fleet" in rendered
+
+
+def test_fleet_sigusr1_dumps_bundle_and_keeps_serving(tmp_path):
+    """Front-door forensics parity (the PR 4 surface the fleet was
+    exempt from): SIGUSR1 on a real `makisu-tpu fleet` process dumps
+    a flight-recorder bundle and the front door keeps serving."""
+    import signal
+    import subprocess
+    import sys
+
+    from makisu_tpu.worker import WorkerClient
+    diag_dir = tmp_path / "diag"
+    diag_dir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MAKISU_TPU_DIAG_DIR=str(diag_dir))
+    worker_sock = str(tmp_path / "sw.sock")
+    fleet_sock = str(tmp_path / "sf.sock")
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "makisu_tpu.cli", "worker",
+         "--socket", worker_sock],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    fleet = subprocess.Popen(
+        [sys.executable, "-m", "makisu_tpu.cli", "fleet",
+         "--socket", fleet_sock, "--worker", worker_sock],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    client = WorkerClient(fleet_sock)
+    try:
+        deadline = time.monotonic() + 60
+        while not client.ready():
+            assert time.monotonic() < deadline, "fleet never ready"
+            assert fleet.poll() is None, "fleet died at startup"
+            time.sleep(0.1)
+        fleet.send_signal(signal.SIGUSR1)
+        bundle_path = None
+        deadline = time.monotonic() + 30
+        while bundle_path is None:
+            candidates = [p for p in diag_dir.iterdir()
+                          if "SIGUSR1" in p.name]
+            if candidates:
+                bundle_path = candidates[0]
+                break
+            assert time.monotonic() < deadline, \
+                f"no SIGUSR1 bundle in {list(diag_dir.iterdir())}"
+            time.sleep(0.1)
+        # Wait for the dump to finish writing (atomic rename means a
+        # readable file is a complete file; retry on the race).
+        deadline = time.monotonic() + 10
+        bundle = None
+        while bundle is None:
+            try:
+                bundle = json.loads(bundle_path.read_text())
+            except ValueError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        assert bundle["schema"] == "makisu-tpu.flightrecorder.v1"
+        assert bundle["reason"] == "SIGUSR1"
+        # The front door survived the poke and still answers.
+        assert client.ready()
+        assert fleet.poll() is None
+    finally:
+        for proc in (fleet, worker):
+            proc.terminate()
+        for proc in (fleet, worker):
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+
+
+def test_report_fleet_cli_renders_and_exports(tmp_path, capsys):
+    """`makisu-tpu report --fleet EVENTS` assembles a merged event
+    log and the top-level --trace-out writes the merged Perfetto
+    export (not the report invocation's own empty tree)."""
+    tid = "ab" * 16
+    lines = [
+        {"ts": 10.0, "type": "span_start", "name": "fleet_build",
+         "span_id": "f" * 16, "parent_id": "0" * 15 + "1",
+         "trace_id": tid},
+        {"ts": 10.0, "type": "span_start", "name": "fleet_admit",
+         "span_id": "a" * 16, "parent_id": "f" * 16,
+         "trace_id": tid},
+        {"ts": 10.2, "type": "span_end", "name": "fleet_admit",
+         "span_id": "a" * 16, "duration": 0.2, "trace_id": tid},
+        {"ts": 10.2, "type": "span_start", "name": "fleet_forward",
+         "span_id": "b" * 16, "parent_id": "f" * 16,
+         "trace_id": tid,
+         "attrs": {"worker": "w0", "verdict": "affinity",
+                   "attempt": "0"}},
+        {"ts": 10.5, "type": "queue_wait", "seconds": 0.3,
+         "tenant": "t", "trace_id": tid, "parent_id": "b" * 16,
+         "worker": "w0"},
+        {"ts": 10.5, "type": "span_start", "name": "build",
+         "span_id": "c" * 16, "parent_id": "b" * 16,
+         "trace_id": tid, "worker": "w0"},
+        {"ts": 12.0, "type": "span_end", "name": "build",
+         "span_id": "c" * 16, "duration": 1.5, "trace_id": tid,
+         "worker": "w0"},
+        {"ts": 12.1, "type": "span_end", "name": "fleet_forward",
+         "span_id": "b" * 16, "duration": 1.9, "trace_id": tid},
+        {"ts": 12.1, "type": "span_end", "name": "fleet_build",
+         "span_id": "f" * 16, "duration": 2.1, "trace_id": tid},
+    ]
+    events_path = tmp_path / "fleet-events.jsonl"
+    events_path.write_text(
+        "\n".join(json.dumps(line) for line in lines) + "\n")
+    trace_path = tmp_path / "merged.json"
+    code = cli.main(["--trace-out", str(trace_path),
+                     "report", "--fleet", str(events_path)])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert f"trace {tid}" in out
+    assert "front-door quota wait 0.200s" in out
+    assert "worker queue wait 0.300s" in out
+    assert "attempt 0: worker w0 (affinity)" in out
+    assert "critical path" in out
+    perfetto = json.loads(trace_path.read_text())
+    names = {e["args"]["name"] for e in perfetto["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"makisu-tpu fleet front door", "worker w0"}
+    slices = [e for e in perfetto["traceEvents"] if e["ph"] == "X"]
+    assert {s["name"] for s in slices} >= {
+        "fleet_build", "fleet_admit", "fleet_forward", "build",
+        "queue_wait"}
+    # Worker spans ride the worker's own process track.
+    pid_of = {s["name"]: s["pid"] for s in slices}
+    assert pid_of["build"] != pid_of["fleet_build"]
+    assert pid_of["queue_wait"] == pid_of["build"]

@@ -296,7 +296,10 @@ def test_build_streams_event_frames(tmp_path, worker):
     assert info["labels"]["mode"] == "worker"
     assert client.last_events == streamed
     types = [e["type"] for e in streamed]
-    assert types[0] == "build_start"
+    # The admission wait rides the stream as its own event, BEFORE the
+    # build proper (it happened before the build's registry existed).
+    assert types[0] == "queue_wait"
+    assert types[1] == "build_start"
     assert types[-1] == "build_end"
     assert "span_start" in types and "span_end" in types
     assert "step" in types
