@@ -494,6 +494,38 @@ def make_parser() -> argparse.ArgumentParser:
                              "stale peer-map acks, tenants pinned at "
                              "their quota, and placement-memo drift "
                              "vs actual session residency")
+    doctor.add_argument("--storage", action="store_true",
+                        help="storage-plane diagnosis: census + "
+                             "reference audit + integrity scrub of "
+                             "the four content planes (blob CAS, "
+                             "chunk CAS, packs, recipes). TARGET is "
+                             "a worker control socket (remote "
+                             "report) or a storage dir (local walk; "
+                             "default: the standard storage dir). "
+                             "Exit 1 when findings exist")
+    doctor.add_argument("--repair", action="store_true",
+                        help="with --storage on a DIRECTORY target: "
+                             "delete verified-orphaned zpack twins "
+                             "(without this flag the repair is a "
+                             "dry-run listing)")
+    doctor.add_argument("--eviction-budget", type=int, default=None,
+                        metavar="BYTES",
+                        help="with --storage: publish an eviction "
+                             "dry-run — what LRU eviction down to "
+                             "this byte budget would remove and how "
+                             "many bytes it would free (refused "
+                             "while the chunk CAS LRU seed is "
+                             "incomplete)")
+
+    du = sub.add_parser(
+        "du", help="storage census: per-plane object counts, byte "
+                   "totals, age histogram, per-tenant attribution")
+    du.add_argument("--storage", default="",
+                    help="storage directory (default: the standard "
+                         "storage dir)")
+    du.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine-readable census document "
+                         "(makisu-tpu.census.v1)")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -1100,6 +1132,91 @@ def cmd_explain(args) -> int:
     return 0
 
 
+def cmd_du(args) -> int:
+    """Walk the four content planes (blob CAS, chunk CAS, packs,
+    recipes) under the census IO budget and print per-plane object
+    counts, byte totals, the age histogram, and per-tenant
+    attribution. ``--json`` emits the makisu-tpu.census.v1 document
+    (also cached at ``<storage>/census.json`` for cheap reuse by
+    /healthz and history records)."""
+    import json as json_mod
+
+    from makisu_tpu.cache import census as census_mod
+
+    storage_dir = _storage_dir(args.storage)
+    if not os.path.isdir(storage_dir):
+        raise SystemExit(f"{storage_dir}: not a directory")
+    doc = census_mod.StorageCensus(storage_dir).census()
+    if args.json_out:
+        print(json_mod.dumps(doc, indent=2, default=str))
+    else:
+        print(census_mod.render_du(doc), end="")
+    return 0
+
+
+def _doctor_storage(args) -> int:
+    """``doctor --storage TARGET``: census + reference audit +
+    integrity scrub. A socket target asks the worker for its cached
+    report (the worker's own IO budget and scrub cadence apply); a
+    directory target walks locally and can ``--repair`` orphaned
+    zpack twins. Exit 1 when any finding survives."""
+    import stat as stat_mod
+
+    from makisu_tpu.cache import census as census_mod
+
+    target = args.bundle
+    is_socket = False
+    if target:
+        try:
+            is_socket = stat_mod.S_ISSOCK(os.stat(target).st_mode)
+        except OSError:
+            is_socket = False
+    if is_socket:
+        if args.repair:
+            raise SystemExit(
+                "doctor --storage --repair needs a storage "
+                "DIRECTORY target (repair deletes files; run it "
+                "where the files are, not through a worker socket)")
+        from makisu_tpu.worker import WorkerClient
+        try:
+            report = WorkerClient(target).storage(
+                eviction_budget=args.eviction_budget)
+        except (OSError, RuntimeError, ValueError) as e:
+            raise SystemExit(
+                f"worker on {target} not reachable: {e}")
+        entries = list(report.get("storage") or [])
+    else:
+        storage_dir = _storage_dir(target)
+        if not os.path.isdir(storage_dir):
+            raise SystemExit(
+                f"{storage_dir}: neither a worker socket nor a "
+                f"storage directory")
+        census = census_mod.StorageCensus(storage_dir)
+        entry = {"storage_dir": storage_dir,
+                 "census": census.census(),
+                 "audit": census.audit(),
+                 "scrub": census.scrub()}
+        seed = census_mod.seed_states(storage_dir)
+        if seed:
+            entry["lru_seed"] = seed
+        if args.eviction_budget is not None:
+            entry["eviction_dry_run"] = census.eviction_dry_run(
+                args.eviction_budget, seed_state=seed)
+        repairable = [f for f in entry["audit"]["findings"]
+                      if f.get("repairable")]
+        if repairable:
+            entry["repair"] = census.repair_orphaned_zpacks(
+                repairable, apply=args.repair)
+        entries = [entry]
+    print(census_mod.render_storage_doctor(
+        entries, target or "local storage"), end="")
+    total = sum(
+        len((e.get("audit") or {}).get("findings") or [])
+        + len((e.get("scrub") or {}).get("findings") or [])
+        for e in entries)
+    return 1 if total else 0
+
+
 def cmd_doctor(args) -> int:
     """Render a diagnostic bundle into a human diagnosis: the stuck
     span, wedged threads, transfer-engine backlog, and the resource
@@ -1112,6 +1229,8 @@ def cmd_doctor(args) -> int:
 
     from makisu_tpu.utils import flightrecorder
 
+    if getattr(args, "storage", False):
+        return _doctor_storage(args)
     if getattr(args, "fleet", False):
         from makisu_tpu.fleet import doctor as fleet_doctor
         from makisu_tpu.worker import WorkerClient
@@ -1440,7 +1559,8 @@ def main(argv: list[str] | None = None) -> int:
                 "fleet": cmd_fleet, "report": cmd_report,
                 "doctor": cmd_doctor, "explain": cmd_explain,
                 "check": cmd_check, "top": cmd_top,
-                "loadgen": cmd_loadgen, "history": cmd_history}
+                "loadgen": cmd_loadgen, "history": cmd_history,
+                "du": cmd_du}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -1665,12 +1785,27 @@ def main(argv: list[str] | None = None) -> int:
             report["command"] = args.command or ""
             report["exit_code"] = code
             if history_path:
+                # Storage-plane snapshot beside the perf gates: the
+                # CACHED census totals only (census.json written by
+                # the last walk) — a history append must never pay a
+                # multi-GB store walk.
+                storage_bytes = None
+                try:
+                    from makisu_tpu.cache import census as census_mod
+                    storage_bytes = census_mod.cached_totals(
+                        _storage_dir(getattr(args, "storage", "")))
+                except Exception as exc:  # noqa: BLE001 - telemetry
+                    log.debug("history storage snapshot skipped: %s",
+                              exc)
+                    storage_bytes = None
+                extra = ({"storage_bytes": storage_bytes}
+                         if storage_bytes else {})
                 try:
                     history_mod.append_record(
                         history_path,
                         history_mod.record_from_report(
                             report, command=args.command or "",
-                            exit_code=code))
+                            exit_code=code, **extra))
                     log.info("history record appended to %s",
                              history_path)
                 except OSError as e:

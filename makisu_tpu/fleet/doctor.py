@@ -94,7 +94,46 @@ def diagnose_fleet(health: dict) -> list[dict]:
                                  f"the front door" if waiting
                                  else "") + ")",
                 })
-    # 5. Placement-memo drift: the sticky memo says a context lives
+    # 5. Storage-plane findings: each worker's /healthz carries a
+    # census digest (PR 16) — cached audit/scrub finding counts and
+    # the chunk-CAS LRU-seed state. A worker reporting findings has
+    # inconsistent content planes (dangling refs, orphaned twins,
+    # scrub corruption); an unseeded LRU map means eviction decisions
+    # there would be blind.
+    for w in alive:
+        wid = w.get("id", "?")
+        storage = w.get("storage") or {}
+        if not storage:
+            continue
+        s_findings = storage.get("findings") or {}
+        total = int(s_findings.get("total", 0) or 0)
+        if total:
+            kinds = ", ".join(
+                f"{kind}={count}" for kind, count in sorted(
+                    (s_findings.get("kinds") or {}).items()))
+            findings.append({
+                "severity": "warning",
+                "kind": "storage_findings",
+                "worker": wid,
+                "detail": f"worker {wid} reports {total} storage "
+                          f"finding(s) ({kinds or 'unclassified'}) — "
+                          f"run `makisu-tpu doctor --storage "
+                          f"<socket>` against it for the object "
+                          f"list",
+            })
+        seed = storage.get("lru_seed") or {}
+        if seed.get("state") not in (None, "seeded"):
+            findings.append({
+                "severity": "info",
+                "kind": "storage_unseeded",
+                "worker": wid,
+                "detail": f"worker {wid}'s chunk-CAS LRU map is "
+                          f"{seed.get('state')} "
+                          f"({seed.get('seeded_entries', 0)} "
+                          f"entries seeded) — eviction dry-runs "
+                          f"refuse until the seed completes",
+            })
+    # 6. Placement-memo drift: the sticky memo says a context lives
     # on worker X, but no alive worker — or a DIFFERENT one — reports
     # the resident session. Routing still works (the memo re-places),
     # but warm state is not where the scheduler thinks it is.
@@ -165,19 +204,24 @@ def render_fleet_doctor(health: dict, socket_path: str = "") -> str:
             + ("armed" if self_section.get("watchdog_armed")
                else "off"))
     lines.append("")
+    from makisu_tpu.utils import traceexport
     lines.append(f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} "
-                 f"{'QUEUE':>6s} {'SESS':>5s} {'PEERMAP':>8s}  "
-                 f"LAST ERROR")
+                 f"{'QUEUE':>6s} {'SESS':>5s} {'PEERMAP':>8s} "
+                 f"{'STORAGE':>8s}  LAST ERROR")
     acked = peer_map.get("acked") or {}
     for w in workers:
         wid = w.get("id", "?")
         held = acked.get(wid)
+        storage = w.get("storage") or {}
+        stor = (traceexport.fmt_bytes(storage.get("total_bytes", 0))
+                if storage else "-")
         lines.append(
             f"{wid:<8s} {w.get('state', '?'):<9s} "
             f"{w.get('active_builds', 0):>6d} "
             f"{w.get('queue_depth', 0):>6d} "
             f"{len(w.get('sessions') or []):>5d} "
-            f"{('v' + str(held)) if held is not None else '-':>8s}  "
+            f"{('v' + str(held)) if held is not None else '-':>8s} "
+            f"{stor:>8s}  "
             f"{w.get('last_error') or '-'}")
     findings = diagnose_fleet(health)
     lines.append("")

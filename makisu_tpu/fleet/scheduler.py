@@ -159,6 +159,11 @@ class WorkerState:
         # serve (recipes/packs its builds published) and how much it
         # has served — the peer plane's capacity signal per worker.
         self.serve: dict = {}
+        # Storage-plane digest from /healthz: per-plane census totals,
+        # LRU-seed state, and cached audit/scrub finding counts — the
+        # front door's view of how full (and how consistent) each
+        # worker's content planes are.
+        self.storage: dict = {}
         self.builds_succeeded = 0
         self.builds_failed = 0
         # Local estimate: builds this front door currently has open
@@ -191,6 +196,7 @@ class WorkerState:
             "sessions": sorted(self.sessions),
             "session_hits": self.session_hits,
             "serve": dict(self.serve),
+            "storage": dict(self.storage),
             "builds_succeeded": self.builds_succeeded,
             "builds_failed": self.builds_failed,
             "routed_total": self.routed_total,
@@ -318,6 +324,7 @@ class FleetScheduler:
                     for row in sessions.get("sessions", [])}
                 state.session_hits = int(sessions.get("hits", 0))
                 state.serve = dict(health.get("serve") or {})
+                state.storage = dict(health.get("storage") or {})
                 if not was_alive:
                     self._peer_version += 1  # membership changed
                 else:

@@ -81,6 +81,23 @@ class CASStore:
         threading.Thread(target=run, daemon=True,
                          name="cas-lru-seed").start()
 
+    def seed_state(self) -> dict:
+        """Observability for the background LRU seed (PR 10's thread
+        is otherwise invisible): ``state`` is ``seeded`` (recency map
+        complete), ``seeding`` (scan in flight), or ``unseeded``
+        (large store, seed not yet armed — it arms on first write).
+        Consumers that rank objects by recency (the storage plane's
+        eviction dry-run) refuse to run unless ``seeded``."""
+        with self._lock:
+            if self._seeded:
+                state = "seeded"
+            elif self._seeding:
+                state = "seeding"
+            else:
+                state = "unseeded"
+            return {"state": state,
+                    "seeded_entries": len(self._last_access)}
+
     def _path(self, name: str) -> str:
         shard = name[:_SHARD_CHARS] if len(name) > _SHARD_CHARS else "__"
         return os.path.join(self.root, shard, name)

@@ -46,8 +46,9 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
         + (f" ({len(stale)} stale ack(s))" if stale else ""),
         f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} {'QUEUE':>6s} "
         f"{'SESS':>5s} {'POLL':>6s} {'PEERMAP':>8s} "
-        f"{'ROUTED':>7s}  SOCKET",
+        f"{'ROUTED':>7s} {'STORAGE':>8s}  SOCKET",
     ]
+    from makisu_tpu.utils.traceexport import fmt_bytes
     for w in fleet.get("workers", []):
         wid = w.get("id", "?")
         poll_age = w.get("last_poll_age_seconds")
@@ -55,6 +56,9 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
         peermap = f"v{held}" if held is not None else "-"
         if wid in stale:
             peermap += "!"
+        storage = w.get("storage") or {}
+        stor = (fmt_bytes(storage.get("total_bytes", 0))
+                if storage else "-")
         lines.append(
             f"{_trunc(wid, 8):<8s} "
             f"{w.get('state', '?'):<9s} "
@@ -63,7 +67,8 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
             f"{len(w.get('sessions', [])):>5d} "
             f"{_fmt_age(poll_age) if poll_age is not None else '-':>6s} "
             f"{peermap:>8s} "
-            f"{w.get('routed_total', 0):>7d}  "
+            f"{w.get('routed_total', 0):>7d} "
+            f"{stor:>8s}  "
             f"{_trunc(w.get('socket', ''), 36)}")
     totals = fleet.get("route_totals", {})
     if totals:

@@ -299,6 +299,15 @@ def aggregate(records: list[dict]) -> dict:
             workers[worker] = workers.get(worker, 0) + 1
     if workers:
         out["dominant_worker"] = max(sorted(workers), key=workers.get)
+    # Storage-plane snapshot: the LATEST record carrying one (records
+    # gain `storage_bytes` from the cached census — cli.main attaches
+    # it when a census.json exists). Latest wins because disk usage is
+    # a level, not a rate: the newest record IS the current state.
+    for r in reversed(records):
+        planes = r.get("storage_bytes")
+        if isinstance(planes, dict) and planes:
+            out["storage_bytes"] = dict(planes)
+            break
     return out
 
 
@@ -369,6 +378,29 @@ def diff(a: list[dict], b: list[dict],
             **({"baseline_worker": dwa, "candidate_worker": dwb}
                if dwa != dwb and (dwa or dwb) else {}),
         }
+    # Storage-growth gate: a content plane that grew beyond the
+    # threshold between baseline and candidate is a retention leak the
+    # perf gates can't see (the build got no slower — the disk just
+    # filled). Skipped when either side lacks the snapshot (pre-PR-16
+    # files), like every other optional label.
+    sa = agg_a.get("storage_bytes") or {}
+    sb = agg_b.get("storage_bytes") or {}
+    growth: list[dict] = []
+    for plane in sorted(set(sa) | set(sb)):
+        if plane == "total":
+            continue
+        va = int(sa.get(plane, 0) or 0)
+        vb = int(sb.get(plane, 0) or 0)
+        if va <= 0:
+            continue
+        change = (vb - va) / va
+        if change > threshold:
+            growth.append({"plane": plane, "baseline": va,
+                           "candidate": vb,
+                           "change": round(change, 4)})
+    if growth:
+        result["storage_growth"] = growth
+        result["ok"] = False
     return result
 
 
@@ -466,9 +498,17 @@ def render_diff(result: dict) -> str:
         lines.append(
             f"  routing mix: {detail}  (latency deltas may be fleet "
             f"placement, not code)")
+    growth = result.get("storage_growth") or []
+    for g in growth:
+        lines.append(
+            f"  storage plane {g['plane']}: {g['baseline']} → "
+            f"{g['candidate']} bytes "
+            f"({100.0 * g['change']:+.1f}%)  ← GROWTH")
     lines.append("")
-    if result["regressions"]:
-        names = ", ".join(r["metric"] for r in result["regressions"])
+    if result["regressions"] or growth:
+        names = ", ".join(
+            [r["metric"] for r in result["regressions"]]
+            + [f"storage:{g['plane']}" for g in growth])
         lines.append(f"REGRESSION: {names} beyond the "
                      f"{100.0 * result['threshold']:.0f}% threshold")
     else:

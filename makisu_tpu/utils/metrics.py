@@ -159,6 +159,19 @@ FLEET_AGGREGATED_SCRAPES = "makisu_fleet_aggregated_scrapes_total"
 # churn is visible on /metrics.
 SERVE_ACCESS_TOTAL = "makisu_serve_access_total"
 
+# Storage observability plane (cache/census.py): per-plane census
+# gauges (plane=blobs|chunks|packs|recipes), per-tenant attribution
+# (tenant labels capped via census.cap_label), audit findings by kind,
+# and the sampled integrity scrub's progress/corruption counters.
+STORAGE_BYTES = "makisu_storage_bytes"
+STORAGE_OBJECTS = "makisu_storage_objects"
+STORAGE_TENANT_BYTES = "makisu_storage_tenant_bytes"
+STORAGE_FINDINGS = "makisu_storage_findings"
+STORAGE_CENSUS_RUNS = "makisu_storage_census_runs_total"
+STORAGE_SCRUB_CHUNKS = "makisu_storage_scrub_chunks_total"
+STORAGE_SCRUB_BYTES = "makisu_storage_scrub_bytes_total"
+STORAGE_SCRUB_CORRUPT = "makisu_storage_scrub_corrupt_total"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.

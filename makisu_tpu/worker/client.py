@@ -177,6 +177,16 @@ class WorkerHealth(dict):
         return int(self.get("sessions", {}).get("resident_bytes", 0))
 
     @property
+    def storage(self) -> dict:
+        """The ``storage`` section: per-plane census digest, total
+        bytes, the chunk CAS LRU seed state, and finding counts."""
+        return dict(self.get("storage", {}))
+
+    @property
+    def storage_total_bytes(self) -> int:
+        return int(self.get("storage", {}).get("total_bytes", 0))
+
+    @property
     def device_probe_state(self) -> str:
         """Probe verdict: ok|pending|wedged|failed|absent|disabled."""
         return str(self.get("device", {}).get("probe", {})
@@ -375,6 +385,23 @@ class WorkerClient:
             if resp.status != 200:
                 raise RuntimeError(
                     f"worker /sessions returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def storage(self, eviction_budget: int | None = None) -> dict:
+        """The worker's ``GET /storage`` payload: per-storage-dir
+        census + reference audit (+ eviction dry-run when a budget is
+        given) and the latest scrub cycle — the full document behind
+        /healthz's cached ``storage`` digest."""
+        path = "/storage"
+        if eviction_budget is not None:
+            path += f"?eviction_budget={int(eviction_budget)}"
+        conn, resp = self._control(path)
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /storage returned {resp.status}")
             return json.loads(resp.read())
         finally:
             conn.close()

@@ -57,6 +57,28 @@ _RECENT_BUILDS_KEEP = 32
 _TENANT_LABELS_KEEP = 32
 _TENANT_OVERFLOW = "other"
 
+# Storage observability knobs. Census TTL bounds how often a /healthz
+# poll may trigger a fresh walk; the scrub interval paces the
+# background integrity cycle (0 disables it — tests drive scrubs
+# directly). Scrub corruption findings kept for /healthz//storage.
+_SCRUB_FINDINGS_KEEP = 64
+
+
+def _census_ttl_seconds() -> float:
+    try:
+        return float(os.environ.get(
+            "MAKISU_TPU_CENSUS_TTL_SECONDS", "60"))
+    except ValueError:
+        return 60.0
+
+
+def _scrub_interval_seconds() -> float:
+    try:
+        return float(os.environ.get(
+            "MAKISU_TPU_STORAGE_SCRUB_SECONDS", "300"))
+    except ValueError:
+        return 300.0
+
 
 class _QuantileRing:
     """Bounded ring of raw observations with exact percentile export.
@@ -168,6 +190,10 @@ class _BuildRecord:
         self._last_event_mono = self.enqueued_mono
         self._mu = threading.Lock()
         self._ledger = ledger.LedgerSummary()
+        # Layer hexes this build's cache decisions named (chunk_cas /
+        # chunk_index keys, kv hits' layer field): the join rows the
+        # storage census's per-tenant attribution consumes.
+        self._layer_hexes: set[str] = set()
 
     @staticmethod
     def _tag_of(argv: list[str]) -> str:
@@ -194,6 +220,15 @@ class _BuildRecord:
                     self.phase = phase
             elif etype == ledger_mod.EVENT_TYPE:
                 self._ledger.add(event)
+                for value in (event.get("key"), event.get("layer")):
+                    value = str(value or "")
+                    if len(value) == 64 and all(
+                            c in "0123456789abcdef" for c in value):
+                        self._layer_hexes.add(value)
+
+    def layer_hexes(self) -> set[str]:
+        with self._mu:
+            return set(self._layer_hexes)
 
     def start_running(self, queue_wait: float) -> None:
         with self._mu:
@@ -332,6 +367,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self, self.path[len("/zpacks/"):],
                 roots=self.server.served_chunk_roots(),
                 access=self.server.serve_access)
+        elif self.path == "/storage" or self.path.startswith("/storage?"):
+            # Storage observability plane: fresh census + reference
+            # audit per storage dir (plus the latest scrub cycle), and
+            # — when asked with ?eviction_budget=BYTES — the eviction
+            # dry-run report real eviction will consume. /healthz
+            # carries the cheap cached digest; this endpoint is the
+            # full document `doctor --storage SOCKET` renders.
+            from urllib.parse import parse_qs, urlsplit
+            query = parse_qs(urlsplit(self.path).query)
+            budget = None
+            raw = (query.get("eviction_budget") or [None])[0]
+            if raw is not None:
+                try:
+                    budget = int(raw)
+                except ValueError:
+                    self._respond(400, b"bad eviction_budget")
+                    return
+            self._respond(
+                200,
+                json.dumps(self.server.storage_report(
+                    eviction_budget=budget), default=str).encode(),
+                content_type="application/json")
         elif self.path == "/serve/access":
             # This worker's serve access ledger: every peer/delta
             # fetch it answered, stamped with the requesting build's
@@ -689,6 +746,15 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         # would also hold in-process siblings' stores, and serving a
         # sibling's bytes would fake the cross-host exchange).
         self._served_chunk_roots: set[str] = set()
+        # Storage observability plane (cache/census.py): the storage
+        # DIRS behind those roots, a TTL census cache per dir (healthz
+        # polls must not pay a walk each), and the background scrub
+        # thread, armed lazily by the first storage registration.
+        self._storage_mu = threading.Lock()
+        self._storage_dirs: set[str] = set()
+        self._storage_state: dict[str, dict] = {}
+        self._scrub_thread: threading.Thread | None = None
+        self._scrub_stop = threading.Event()
         # Builds sharing a --root or --storage directory would race on
         # the filesystem; those (and only those) serialize. The lock
         # table is PROCESS-wide (module global), not per server: two
@@ -772,10 +838,175 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             serve_server.register_store(storage_dir)
         with self._builds_mu:
             self._served_chunk_roots.update((root, chunk_root))
+        # The storage DIR (the serve store's root, resolved with the
+        # same chunks/-suffix disambiguation) joins the census set.
+        if os.path.basename(root) == "chunks" \
+                and not os.path.isdir(os.path.join(root, "serve")):
+            self._add_storage_dir(os.path.dirname(root))
+        else:
+            self._add_storage_dir(root)
 
     def served_chunk_roots(self) -> set[str]:
         with self._builds_mu:
             return set(self._served_chunk_roots)
+
+    # -- storage observability (census / audit / scrub) -------------------
+
+    def _add_storage_dir(self, storage_dir: str) -> None:
+        with self._storage_mu:
+            self._storage_dirs.add(os.path.realpath(storage_dir))
+            if self._scrub_thread is None:
+                interval = _scrub_interval_seconds()
+                if interval > 0:
+                    # Process-level maintenance thread: the scrub
+                    # outlives any single build and must not pin one
+                    # build's registry/log sink.
+                    # check: allow(ctx-propagation)
+                    self._scrub_thread = threading.Thread(
+                        target=self._scrub_loop, args=(interval,),
+                        daemon=True, name="storage-scrub")
+                    self._scrub_thread.start()
+
+    def storage_dirs(self) -> list[str]:
+        with self._storage_mu:
+            return sorted(self._storage_dirs)
+
+    def _census_for(self, storage_dir: str,
+                    max_age: float | None = None) -> dict:
+        """This dir's census, through the TTL cache — /healthz polls
+        arrive every few seconds and must not each pay a walk."""
+        from makisu_tpu.cache import census as census_mod
+        if max_age is None:
+            max_age = _census_ttl_seconds()
+        now = time.monotonic()
+        with self._storage_mu:
+            state = self._storage_state.setdefault(storage_dir, {})
+            doc = state.get("census")
+            if doc is not None \
+                    and now - state.get("census_mono", 0.0) < max_age:
+                return doc
+        doc = census_mod.StorageCensus(storage_dir).census()
+        with self._storage_mu:
+            state = self._storage_state.setdefault(storage_dir, {})
+            state["census"] = doc
+            state["census_mono"] = time.monotonic()
+        return doc
+
+    def storage_health(self) -> dict:
+        """The /healthz ``storage`` digest: per-plane totals summed
+        across this worker's storage dirs, the chunk CAS LRU seed
+        state (worst dir wins — an eviction dry-run must know), and
+        the latest audit/scrub finding counts."""
+        from makisu_tpu.cache import census as census_mod
+        dirs = self.storage_dirs()
+        planes: dict[str, dict] = {}
+        total_bytes = 0
+        total_objects = 0
+        seed = {"state": "seeded", "seeded_entries": 0}
+        seed_rank = {"unseeded": 0, "seeding": 1, "seeded": 2}
+        finding_kinds: dict[str, int] = {}
+        for storage_dir in dirs:
+            try:
+                doc = self._census_for(storage_dir)
+            except OSError:
+                continue
+            total_bytes += int(doc.get("total_bytes", 0) or 0)
+            total_objects += int(doc.get("total_objects", 0) or 0)
+            for plane, row in (doc.get("planes") or {}).items():
+                agg = planes.setdefault(plane,
+                                        {"objects": 0, "bytes": 0})
+                agg["objects"] += int(row.get("objects", 0) or 0)
+                agg["bytes"] += int(row.get("bytes", 0) or 0)
+            state = census_mod.seed_states(storage_dir)
+            if state:
+                if seed_rank.get(state.get("state"), 0) \
+                        < seed_rank.get(seed["state"], 2):
+                    seed["state"] = state.get("state", "unseeded")
+                seed["seeded_entries"] += int(
+                    state.get("seeded_entries", 0) or 0)
+            with self._storage_mu:
+                cached = self._storage_state.get(storage_dir, {})
+                for f in (cached.get("findings") or []):
+                    kind = str(f.get("kind", "?"))
+                    finding_kinds[kind] = \
+                        finding_kinds.get(kind, 0) + 1
+        return {
+            "dirs": len(dirs),
+            "planes": planes,
+            "total_bytes": total_bytes,
+            "total_objects": total_objects,
+            "lru_seed": seed,
+            "findings": {
+                "total": sum(finding_kinds.values()),
+                "kinds": dict(sorted(finding_kinds.items())),
+            },
+        }
+
+    def storage_report(self,
+                       eviction_budget: int | None = None) -> dict:
+        """The ``GET /storage`` payload: fresh census + reference
+        audit (+ eviction dry-run when a budget is asked for) per
+        storage dir, plus the latest scrub cycle's findings. The dry
+        run consults the LIVE chunk CAS's seed state and refuses on
+        partial recency data."""
+        from makisu_tpu.cache import census as census_mod
+        reports = []
+        for storage_dir in self.storage_dirs():
+            engine = census_mod.StorageCensus(storage_dir)
+            doc = engine.census()
+            audit = engine.audit()
+            entry: dict = {"storage_dir": storage_dir,
+                           "census": doc, "audit": audit}
+            seed = census_mod.seed_states(storage_dir)
+            if seed is not None:
+                entry["lru_seed"] = seed
+            if eviction_budget is not None:
+                entry["eviction_dry_run"] = engine.eviction_dry_run(
+                    eviction_budget, seed_state=seed)
+            with self._storage_mu:
+                state = self._storage_state.setdefault(
+                    storage_dir, {})
+                state["census"] = doc
+                state["census_mono"] = time.monotonic()
+                state["findings"] = list(audit["findings"])
+                entry["scrub"] = dict(state.get("scrub") or {})
+            reports.append(entry)
+        return {"storage": reports}
+
+    def _scrub_loop(self, interval: float) -> None:
+        """Background integrity scrub: every cycle re-hashes a few
+        random chunks + one zpack frame per storage dir under the IO
+        budget, refreshes the census gauges, and parks corruption
+        findings where /healthz and /storage surface them. Corruption
+        events ride the bus (the worker's global flight-recorder sink
+        puts them in crash bundles for free)."""
+        from makisu_tpu.cache import census as census_mod
+        from makisu_tpu.utils import logging as log
+        while not self._scrub_stop.wait(interval):
+            for storage_dir in self.storage_dirs():
+                try:
+                    engine = census_mod.StorageCensus(storage_dir)
+                    doc = engine.census()
+                    result = engine.scrub()
+                except Exception as exc:  # noqa: BLE001 - never kills
+                    log.debug("storage scrub cycle failed for %s: %s",
+                              storage_dir, exc)
+                    continue
+                with self._storage_mu:
+                    state = self._storage_state.setdefault(
+                        storage_dir, {})
+                    state["census"] = doc
+                    state["census_mono"] = time.monotonic()
+                    state["scrub"] = {
+                        "chunks_checked": result["chunks_checked"],
+                        "packs_checked": result["packs_checked"],
+                        "bytes_read": result["bytes_read"],
+                        "corrupt": len(result["findings"]),
+                    }
+                    if result["findings"]:
+                        state.setdefault("findings", [])
+                        state["findings"].extend(result["findings"])
+                        del state["findings"][:-_SCRUB_FINDINGS_KEEP]
 
     def register_build(self, argv: list[str],
                        tenant: str = "") -> _BuildRecord:
@@ -982,6 +1213,14 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 lock.release()
             self._admission.release()
             self._retire_build(record, code)
+            if flags["storage"] and record.tenant:
+                # Ledger → census join: persist this build's layer
+                # hexes under its tenant so the storage census can
+                # attribute the bytes those layers put on disk.
+                from makisu_tpu.cache import census as census_mod
+                census_mod.record_attribution(
+                    flags["storage"], record.tenant,
+                    record.layer_hexes())
             fleet_peers.reset_self_socket(peers_token)
             session_mod.reset_manager(session_token)
             if fleet_token is not None:
@@ -1099,6 +1338,14 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             "device": device,
             "sessions": sessions,
             "serve": serve,
+            # Storage-plane vitals: per-plane object/byte totals over
+            # this worker's storage dirs (TTL-cached census — polls
+            # never pay a fresh walk), the chunk CAS LRU seed state
+            # (satellite of the census work: the background seed
+            # thread was invisible, and eviction dry-runs refuse to
+            # run over its partial recency data), and audit/scrub
+            # finding counts. Full findings live on GET /storage.
+            "storage": self.storage_health(),
             # Seconds since the last observable progress (event bus,
             # log line, or transfer-engine work). A probe alerting on
             # active_builds > 0 && last_progress_seconds > window sees
@@ -1118,6 +1365,7 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
 
     def server_close(self) -> None:
         from makisu_tpu.utils import events
+        self._scrub_stop.set()
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None

@@ -236,3 +236,51 @@ def test_history_missing_path_exits_2(tmp_path):
         with pytest.raises(SystemExit) as exc:
             cli.main(argv)
         assert exc.value.code == 2
+
+
+# -- storage-plane snapshot (PR 16) -----------------------------------------
+
+
+def test_aggregate_carries_latest_storage_snapshot():
+    a = _synthetic(1.0)
+    a["storage_bytes"] = {"chunks": 100, "blobs": 10, "total": 110}
+    b = _synthetic(1.0)
+    b["storage_bytes"] = {"chunks": 300, "blobs": 10, "total": 310}
+    b["ts"] = a["ts"] + 1
+    agg = history.aggregate([a, b])
+    assert agg["storage_bytes"]["chunks"] == 300  # latest wins
+
+
+def test_diff_flags_storage_plane_growth():
+    a = _synthetic(1.0)
+    a["storage_bytes"] = {"chunks": 1000, "blobs": 500, "total": 1500}
+    b = _synthetic(1.0)
+    b["storage_bytes"] = {"chunks": 2000, "blobs": 500, "total": 2500}
+    result = history.diff([a], [b], threshold=0.25)
+    assert not result["ok"]
+    assert result["storage_growth"] == [{
+        "plane": "chunks", "baseline": 1000, "candidate": 2000,
+        "change": 1.0}]
+    rendered = history.render_diff(result)
+    assert "GROWTH" in rendered and "storage:chunks" in rendered
+    # Growth within threshold (and records without snapshots) pass.
+    assert history.diff([a], [a], threshold=0.25)["ok"]
+    assert history.diff([_synthetic(1.0)], [b],
+                        threshold=0.25)["ok"]
+
+
+def test_build_record_carries_cached_census_totals(tmp_path):
+    """cli.main attaches storage_bytes from the CACHED census only —
+    present once a census has run, absent (not a walk!) before."""
+    from makisu_tpu.cache.census import StorageCensus
+    out = tmp_path / "hist.jsonl"
+    assert _build(tmp_path, "sb",
+                  ("--history-out", str(out))) == 0
+    first = history.read_history(str(out))[-1]
+    assert "storage_bytes" not in first  # no census has run yet
+    StorageCensus(str(tmp_path / "sb-storage")).census()
+    assert _build(tmp_path, "sb",
+                  ("--history-out", str(out))) == 0
+    second = history.read_history(str(out))[-1]
+    assert second["storage_bytes"]["chunks"] > 0
+    assert second["storage_bytes"]["total"] > 0
