@@ -244,6 +244,16 @@ def make_parser() -> argparse.ArgumentParser:
                              "latency histograms, GET /builds). "
                              "0 = unlimited (default; env "
                              "MAKISU_TPU_MAX_CONCURRENT_BUILDS)")
+    worker.add_argument("--slo-config", default="", metavar="FILE",
+                        help="SLO rule JSON (docs/SLO.md schema): "
+                             "merged over the built-in worker rules "
+                             "by name; evaluated on a background "
+                             "thread, firing alerts at GET /alerts")
+    worker.add_argument("--alert-webhook", default="", metavar="URL",
+                        help="POST each alert fired/resolved "
+                             "transition here as JSON (bounded "
+                             "timeout; failures counted, never "
+                             "blocking)")
 
     serve = sub.add_parser(
         "serve", help="run a chunk-native distribution endpoint over "
@@ -296,6 +306,36 @@ def make_parser() -> argparse.ArgumentParser:
                             "which the consistent-hash owner of a "
                             "new context is passed over for the "
                             "least-loaded worker")
+    fleet.add_argument("--slo-config", default="", metavar="FILE",
+                       help="SLO rule JSON (docs/SLO.md schema): "
+                            "merged over the built-in fleet rules by "
+                            "name; evaluated over scheduler stats + "
+                            "canary series, served at GET /alerts")
+    fleet.add_argument("--alert-webhook", default="", metavar="URL",
+                       help="POST each alert fired/resolved "
+                            "transition here as JSON")
+    fleet.add_argument("--canary-interval", type=float, default=60.0,
+                       metavar="SECONDS",
+                       help="synthetic canary build cadence: each "
+                            "sweep builds one tiny generated context "
+                            "end-to-end on every alive worker, "
+                            "scoring per-worker health for "
+                            "health-demoted routing (0 disables)")
+    fleet.add_argument("--canary-slow-seconds", type=float,
+                       default=10.0, metavar="SECONDS",
+                       help="canary latency past this counts as bad "
+                            "(feeds the build_latency_burn rule and "
+                            "the health score)")
+
+    alerts_p = sub.add_parser(
+        "alerts", help="render a worker's or fleet front door's "
+                       "active alerts (GET /alerts)")
+    alerts_p.add_argument("socket",
+                          help="worker or fleet unix socket to query")
+    alerts_p.add_argument("--json", action="store_true",
+                          dest="json_out",
+                          help="print the raw /alerts JSON payload "
+                               "instead of the human render")
 
     top = sub.add_parser(
         "top", help="live terminal view of a worker's (or fleet "
@@ -385,6 +425,22 @@ def make_parser() -> argparse.ArgumentParser:
                               "(default 3; >= 3 so the warmup, "
                               "drain, and kill phases each get a "
                               "round)")
+    loadgen.add_argument("--slo-smoke", action="store_true",
+                         help="SLO fault-injection scenario: a "
+                              "3-worker fleet with fast canary/"
+                              "evaluation intervals, one worker "
+                              "wedged via a held admission slot; "
+                              "asserts the latency burn-rate alert "
+                              "fires, routing shifts away "
+                              "(health_demoted in the route ledger), "
+                              "canary digests stay identical on "
+                              "healthy workers, and the alert "
+                              "resolves after the fault clears")
+    loadgen.add_argument("--alert-events-out", default="",
+                         metavar="FILE",
+                         help="slo-smoke: write the alert transitions "
+                              "(fired/resolved) as an alert-only "
+                              "NDJSON file — the CI artifact")
 
     history = sub.add_parser(
         "history", help="render build-history trends, or `history "
@@ -1250,7 +1306,16 @@ def cmd_doctor(args) -> int:
                 f"{args.bundle} answers /healthz but carries no "
                 f"fleet section — is it a worker socket? point "
                 f"doctor --fleet at the `makisu-tpu fleet` socket")
-        print(fleet_doctor.render_fleet_doctor(health, args.bundle),
+        # Active alerts render as findings (severity-ordered with the
+        # rest of the diagnosis). Best-effort: a front door predating
+        # /alerts still gets the healthz-digest fallback.
+        alerts_snap = None
+        try:
+            alerts_snap = client.alerts()
+        except (OSError, RuntimeError, ValueError):
+            pass
+        print(fleet_doctor.render_fleet_doctor(health, args.bundle,
+                                               alerts=alerts_snap),
               end="")
         return 0
     if args.device:
@@ -1270,6 +1335,29 @@ def cmd_doctor(args) -> int:
         raise SystemExit(
             "doctor needs a diagnostic-bundle path (or --device for "
             "the device-route ledger diagnosis)")
+    import stat as stat_mod
+    if os.path.exists(args.bundle) and stat_mod.S_ISSOCK(
+            os.stat(args.bundle).st_mode):
+        # A live control socket instead of a bundle file: render the
+        # process's active alerts as a diagnosis (works against a
+        # worker or a fleet front door — the payload names itself).
+        from makisu_tpu.fleet import doctor as fleet_doctor
+        from makisu_tpu.utils import alerts as alerts_mod
+        from makisu_tpu.worker import WorkerClient
+        try:
+            snap = WorkerClient(args.bundle).alerts()
+        except (OSError, RuntimeError, ValueError) as e:
+            raise SystemExit(
+                f"{args.bundle} is a socket but /alerts failed: {e}")
+        print(alerts_mod.render_alerts(
+            snap, heading=f"{snap.get('source') or '?'} alerts — "
+                          f"{args.bundle}"))
+        findings = fleet_doctor.alert_findings(snap)
+        if findings:
+            print(f"\ndiagnosis ({len(findings)} finding(s)):")
+            for f in findings:
+                print(f"  [{f['severity']:<7s}] {f['detail']}")
+        return 0
     with open(args.bundle, encoding="utf-8") as f:
         bundle = json_mod.load(f)
     if bundle.get("schema") != flightrecorder.BUNDLE_SCHEMA:
@@ -1349,7 +1437,9 @@ def cmd_worker(args) -> int:
                                         None),
                           diag_out=args.diag_out,
                           max_concurrent_builds=
-                          args.max_concurrent_builds)
+                          args.max_concurrent_builds,
+                          slo_config=args.slo_config,
+                          alert_webhook=args.alert_webhook)
     # Process-level signal forensics: a worker killed by its
     # supervisor (SIGTERM) or poked for live inspection (SIGUSR1)
     # dumps a bundle covering EVERY in-flight build — the server's
@@ -1419,7 +1509,11 @@ def cmd_fleet(args) -> int:
         max_inflight=args.max_inflight_builds,
         spillover_queue_depth=args.spillover_queue_depth,
         stall_window=(args.stall_timeout or None),
-        diag_out=args.diag_out)
+        diag_out=args.diag_out,
+        slo_config=args.slo_config,
+        alert_webhook=args.alert_webhook,
+        canary_interval=args.canary_interval,
+        canary_slow_seconds=args.canary_slow_seconds)
     # Process-level signal forensics, at parity with cmd_worker: a
     # SIGTERM'd front door dumps a bundle covering every in-flight
     # routed build (the server's recorder sees all contexts via the
@@ -1471,6 +1565,46 @@ def cmd_fleet(args) -> int:
             except (OSError, ValueError) as e:
                 log.error("failed to write merged fleet trace: %s", e)
             args.trace_out = ""
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """Fetch and render ``GET /alerts`` from a worker or fleet front
+    door: active alerts severity-first, the recently-resolved ring,
+    and — on a fleet socket — each worker's own section."""
+    import json as json_mod
+
+    from makisu_tpu.utils import alerts as alerts_mod
+    from makisu_tpu.worker import WorkerClient
+    client = WorkerClient(args.socket)
+    try:
+        snap = client.alerts()
+    except (OSError, RuntimeError, ValueError) as e:
+        raise SystemExit(
+            f"cannot fetch /alerts from {args.socket}: {e}")
+    if args.json_out:
+        print(json_mod.dumps(snap, indent=1))
+        return 0
+    source = snap.get("source") or "?"
+    print(alerts_mod.render_alerts(
+        snap, heading=f"{source} alerts — {args.socket}"))
+    for wid, payload in sorted((snap.get("workers") or {}).items()):
+        print()
+        if payload.get("error"):
+            print(f"worker {wid}: {payload['error']}")
+        else:
+            print(alerts_mod.render_alerts(
+                payload, heading=f"worker {wid}"))
+    canary = snap.get("canary") or {}
+    if canary.get("workers"):
+        print(f"\ncanary: {canary.get('sweeps', 0)} sweep(s), digest "
+              f"mismatch={str(bool(canary.get('digest_mismatch'))).lower()}")
+        for wid, row in sorted(canary["workers"].items()):
+            print(f"  {wid}: score {row.get('score', 1.0):g} "
+                  f"({row.get('bad', 0)}/{row.get('total', 0)} bad, "
+                  f"last {row.get('latency_seconds', 0):g}s"
+                  + (f", error: {row['error']}" if row.get("error")
+                     else "") + ")")
     return 0
 
 
@@ -1559,6 +1693,7 @@ def main(argv: list[str] | None = None) -> int:
                 "fleet": cmd_fleet, "report": cmd_report,
                 "doctor": cmd_doctor, "explain": cmd_explain,
                 "check": cmd_check, "top": cmd_top,
+                "alerts": cmd_alerts,
                 "loadgen": cmd_loadgen, "history": cmd_history,
                 "du": cmd_du}
     handler = handlers.get(args.command)
@@ -1592,6 +1727,13 @@ def main(argv: list[str] | None = None) -> int:
     # story. A malformed value mints fresh ids (counted, never fatal).
     metrics.adopt_inbound(registry, metrics.inbound_traceparent())
     metrics_token = metrics.set_build_registry(registry)
+    # Alerts fired during this invocation's window: the SLO evaluator
+    # (worker/fleet background thread) bumps the process-GLOBAL fired
+    # counter, so the delta across this build is what the history
+    # record carries — `history diff` attributes latency regressions
+    # that coincide with alert storms.
+    alerts_fired_base = metrics.global_registry().counter_total(
+        metrics.ALERTS_FIRED)
     # Deploy-identity info gauge: constant 1, identity in the labels
     # (the node_exporter "build_info" idiom). Scrapers join it against
     # rate() series to slice by version/hasher/platform/mode.
@@ -1800,6 +1942,9 @@ def main(argv: list[str] | None = None) -> int:
                     storage_bytes = None
                 extra = ({"storage_bytes": storage_bytes}
                          if storage_bytes else {})
+                extra["alerts_fired"] = int(
+                    metrics.global_registry().counter_total(
+                        metrics.ALERTS_FIRED) - alerts_fired_base)
                 try:
                     history_mod.append_record(
                         history_path,

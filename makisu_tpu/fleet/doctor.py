@@ -12,12 +12,56 @@ the payload, so tests feed canned snapshots.
 
 from __future__ import annotations
 
+# Alert severity → doctor finding severity (the two vocabularies
+# predate each other: alerts page/warn/info, findings error/warning/
+# info). Unknown alert severities map to warning — visible, not fatal.
+_ALERT_SEVERITY = {"page": "error", "warn": "warning", "info": "info"}
 
-def diagnose_fleet(health: dict) -> list[dict]:
-    """Structured findings from a fleet front door's ``/healthz``
-    payload. Each finding: ``{"severity": "error"|"warning"|"info",
-    "kind": ..., "detail": ...}``, most severe first."""
+
+def alert_findings(alerts: dict | None) -> list[dict]:
+    """Findings from a ``GET /alerts`` payload (worker or fleet
+    shape): every active alert becomes one finding, severity mapped
+    through ``_ALERT_SEVERITY``. The fleet payload's per-worker
+    sections contribute worker-tagged findings."""
+    if not alerts:
+        return []
     findings: list[dict] = []
+
+    def add(active, worker: str = "") -> None:
+        for a in active or []:
+            name = a.get("rule", "?")
+            if a.get("label"):
+                name = f"{name}[{a['label']}]"
+            detail = (f"alert {name} firing"
+                      + (f" on worker {worker}" if worker else "")
+                      + f": {a.get('message') or name}")
+            if a.get("value") is not None \
+                    and a.get("threshold") is not None:
+                detail += (f" (value {a['value']:g} vs threshold "
+                           f"{a['threshold']:g})")
+            findings.append({
+                "severity": _ALERT_SEVERITY.get(
+                    str(a.get("severity")), "warning"),
+                "kind": "alert",
+                "rule": a.get("rule", "?"),
+                "worker": worker,
+                "detail": detail,
+            })
+
+    add(alerts.get("active"))
+    for wid, payload in sorted((alerts.get("workers") or {}).items()):
+        if isinstance(payload, dict):
+            add(payload.get("active"), wid)
+    return findings
+
+
+def diagnose_fleet(health: dict,
+                   alerts: dict | None = None) -> list[dict]:
+    """Structured findings from a fleet front door's ``/healthz``
+    payload (plus, when provided, its ``/alerts`` payload). Each
+    finding: ``{"severity": "error"|"warning"|"info",
+    "kind": ..., "detail": ...}``, most severe first."""
+    findings: list[dict] = alert_findings(alerts)
     fleet = health.get("fleet") or {}
     self_section = health.get("self") or {}
     workers = fleet.get("workers") or []
@@ -41,6 +85,24 @@ def diagnose_fleet(health: dict) -> list[dict]:
                           f"its resident sessions will rebuild "
                           f"elsewhere cold",
             })
+    # 1b. Per-worker alert digests from /healthz (poll-captured): when
+    # the full /alerts payload wasn't fetched, the counts still name
+    # which worker is paging.
+    if alerts is None:
+        for w in alive:
+            digest = w.get("alerts") or {}
+            active = int(digest.get("active", 0) or 0)
+            if active:
+                pages = int(digest.get("page", 0) or 0)
+                findings.append({
+                    "severity": "error" if pages else "warning",
+                    "kind": "alert",
+                    "worker": w.get("id", "?"),
+                    "detail": f"worker {w.get('id', '?')} reports "
+                              f"{active} active alert(s)"
+                              f" ({pages} page) — `makisu-tpu alerts "
+                              f"<socket>` for the rules",
+                })
     # 2. Draining workers: deliberate, but worth naming (drain that
     # never concludes is an operator leak).
     for w in workers:
@@ -170,9 +232,11 @@ def diagnose_fleet(health: dict) -> list[dict]:
     return findings
 
 
-def render_fleet_doctor(health: dict, socket_path: str = "") -> str:
+def render_fleet_doctor(health: dict, socket_path: str = "",
+                        alerts: dict | None = None) -> str:
     """The human rendering: front-door vitals, the per-worker table,
-    then the diagnosis."""
+    then the diagnosis (alert findings first when ``/alerts`` was
+    fetched)."""
     fleet = health.get("fleet") or {}
     self_section = health.get("self") or {}
     workers = fleet.get("workers") or []
@@ -223,7 +287,7 @@ def render_fleet_doctor(health: dict, socket_path: str = "") -> str:
             f"{('v' + str(held)) if held is not None else '-':>8s} "
             f"{stor:>8s}  "
             f"{w.get('last_error') or '-'}")
-    findings = diagnose_fleet(health)
+    findings = diagnose_fleet(health, alerts)
     lines.append("")
     if not findings:
         lines.append("diagnosis: fleet healthy — no findings")

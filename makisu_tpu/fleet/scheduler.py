@@ -166,6 +166,14 @@ class WorkerState:
         self.storage: dict = {}
         self.builds_succeeded = 0
         self.builds_failed = 0
+        # Canary-derived health score (EWMA in [0, 1], 1.0 = healthy).
+        # Written by the canary driver via set_health_score; a worker
+        # that has never been canaried keeps the benefit of the doubt.
+        self.health_score = 1.0
+        # Active-alert digest from the worker's own /healthz
+        # ({"active": n, "page": n, "warn": n}) — what `top`'s ALERTS
+        # column and doctor's fleet view read without a /alerts fan-out.
+        self.alerts: dict = {}
         # Local estimate: builds this front door currently has open
         # against the worker (fresher than any poll).
         self.local_inflight = 0
@@ -199,6 +207,8 @@ class WorkerState:
             "storage": dict(self.storage),
             "builds_succeeded": self.builds_succeeded,
             "builds_failed": self.builds_failed,
+            "health_score": round(self.health_score, 4),
+            "alerts": dict(self.alerts),
             "routed_total": self.routed_total,
             "consecutive_failures": self.consecutive_failures,
             "last_error": self.last_error,
@@ -237,9 +247,23 @@ class FleetScheduler:
                  poll_interval: float = 1.0,
                  tenant_quota: int = 0,
                  max_inflight: int = 0,
-                 spillover_queue_depth: int = 2) -> None:
+                 spillover_queue_depth: int = 2,
+                 health_page_threshold: float | None = None) -> None:
         if not specs:
             raise ValueError("a fleet needs at least one worker")
+        if health_page_threshold is None:
+            # Lazy: scheduler is imported by fleet/__init__ before
+            # fleet.slo; resolving the default here keeps one source
+            # of truth without an import-time cycle.
+            from makisu_tpu.fleet import slo as _slo
+            health_page_threshold = _slo.HEALTH_PAGE_THRESHOLD
+        # Workers whose canary health score sits at/below this are
+        # demoted: spillover/failover prefer healthier peers, and
+        # every skip lands in the decision ledger as health_demoted.
+        # Affinity still wins — a resident session is worth more than
+        # a flaky canary, and demotion must not shed the warm state
+        # that makes the worker worth routing to once it recovers.
+        self.health_page_threshold = float(health_page_threshold)
         self._mu = threading.Lock()
         self.workers: dict[str, WorkerState] = {
             spec.id: WorkerState(spec) for spec in specs}
@@ -325,6 +349,7 @@ class FleetScheduler:
                 state.session_hits = int(sessions.get("hits", 0))
                 state.serve = dict(health.get("serve") or {})
                 state.storage = dict(health.get("storage") or {})
+                state.alerts = dict(health.get("alerts") or {})
                 if not was_alive:
                     self._peer_version += 1  # membership changed
                 else:
@@ -480,11 +505,29 @@ class FleetScheduler:
                     if memo in candidates:
                         chosen = candidates[memo]
                         verdict, reason = "affinity", "sticky"
+            demoted: list[tuple[str, float]] = []
+            pool = candidates
+            if chosen is None:
+                # Health demotion (spillover/failover only — a worker
+                # holding this context's session was already chosen
+                # above regardless of score): drop workers whose
+                # canary health score is at/below the page threshold,
+                # unless that would empty the pool — a degraded worker
+                # beats NoWorkersError.
+                healthy = {
+                    wid: w for wid, w in candidates.items()
+                    if w.health_score > self.health_page_threshold}
+                if healthy and len(healthy) < len(candidates):
+                    demoted = sorted(
+                        (wid, w.health_score)
+                        for wid, w in candidates.items()
+                        if wid not in healthy)
+                    pool = healthy
             if chosen is None and context_key:
                 # 2. Consistent-hash placement for new contexts.
                 owner_id = self._ring_owner(context_key,
-                                            set(candidates))
-                owner = candidates.get(owner_id)
+                                            set(pool))
+                owner = pool.get(owner_id)
                 if owner is not None and owner.load() \
                         < self.spillover_queue_depth:
                     chosen, reason = owner, "placed"
@@ -493,7 +536,7 @@ class FleetScheduler:
             if chosen is None:
                 # 3. Least-loaded (no context identity, or the hash
                 # owner is saturated).
-                chosen = min(candidates.values(),
+                chosen = min(pool.values(),
                              key=lambda w: (w.load(), w.spec.id))
                 reason = reason or "no_context"
             if attempt > 0:
@@ -502,6 +545,15 @@ class FleetScheduler:
             chosen.routed_total += 1
             if context_key:
                 self._placements[context_key] = chosen.spec.id
+        # Every worker skipped for health gets its own ledgered
+        # decision — the routing shift away from a degraded worker is
+        # auditable from the same surface as every other verdict.
+        for wid, score in demoted:
+            self._record_decision(
+                context_key or "<no-context>", "health_demoted",
+                reason="canary_health", tenant=tenant, worker=wid,
+                score=round(score, 4),
+                threshold=self.health_page_threshold)
         self._record_decision(context_key or "<no-context>", verdict,
                               reason=reason, tenant=tenant,
                               worker=chosen.spec.id, attempt=attempt)
@@ -517,6 +569,36 @@ class FleetScheduler:
         with self._mu:
             return sum(1 for wid, w in self.workers.items()
                        if w.eligible and wid not in exclude)
+
+    def set_health_score(self, worker_id: str, score: float) -> None:
+        """Record a worker's canary-derived health score (the canary
+        driver calls this after every sweep). Clamped to [0, 1]."""
+        with self._mu:
+            state = self.workers.get(worker_id)
+            if state is not None:
+                state.health_score = min(max(float(score), 0.0), 1.0)
+        metrics.global_registry().gauge_set(
+            metrics.WORKER_HEALTH_SCORE, score, worker=worker_id)
+
+    def canary_targets(self) -> list[tuple[str, str, str]]:
+        """``(worker_id, socket_path, storage)`` for every worker a
+        canary sweep should probe. Dead workers are skipped (the poll
+        already tells the story); DRAINING workers are probed — they
+        still serve peer fetches and their health matters for when
+        they come back."""
+        with self._mu:
+            return [(w.spec.id, w.spec.socket_path,
+                     w.spec.storage or "")
+                    for w in sorted(self.workers.values(),
+                                    key=lambda w: w.spec.id)
+                    if w.alive]
+
+    def health_scores(self) -> dict[str, float]:
+        """Current health score per worker — the fleet SLO probe's
+        ``canary_health_score`` level signal."""
+        with self._mu:
+            return {wid: w.health_score
+                    for wid, w in self.workers.items()}
 
     def note_build_done(self, worker_id: str) -> None:
         """A forwarded build finished (success or failure — outcome

@@ -35,6 +35,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler
 
+from makisu_tpu.fleet import slo as slo_mod
 from makisu_tpu.fleet.scheduler import (
     FleetScheduler,
     NoWorkersError,
@@ -114,6 +115,9 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 "peers": [w["socket"] for w in stats["workers"]
                           if w["alive"]],
             }).encode(), content_type="application/json")
+        elif self.path == "/alerts":
+            self._respond(200, json.dumps(server.alerts()).encode(),
+                          content_type="application/json")
         elif self.path == "/exit":
             threading.Thread(target=server.shutdown,
                              daemon=True).start()
@@ -230,7 +234,12 @@ class FleetServer(socketserver.ThreadingMixIn,
                  spillover_queue_depth: int = 2,
                  max_attempts: int = MAX_ATTEMPTS,
                  stall_window: float | None = None,
-                 diag_out: str = "") -> None:
+                 diag_out: str = "",
+                 slo_config: str = "",
+                 alert_webhook: str = "",
+                 slo_interval: float | None = None,
+                 canary_interval: float = 0.0,
+                 canary_slow_seconds: float = 10.0) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _FleetHandler)
@@ -277,6 +286,22 @@ class FleetServer(socketserver.ThreadingMixIn,
                 registry=metrics.global_registry(),
                 active_fn=lambda: self.active_builds() > 0).start()
         self.scheduler.start()
+        # SLO plane: the canary driver (synthetic builds through each
+        # alive worker; off by default — `makisu-tpu fleet` turns it
+        # on) and the rule evaluator over the front door's own vitals
+        # plus the canary series. Constructed after scheduler.start()
+        # so the first tick sees a live worker view.
+        self.canary = slo_mod.CanaryDriver(
+            self.scheduler, interval=canary_interval,
+            slow_seconds=canary_slow_seconds)
+        rules = slo_mod.default_fleet_rules()
+        if slo_config:
+            rules = slo_mod.load_rules(slo_config, rules)
+        self.slo = slo_mod.SloEvaluator(
+            self._slo_probe, rules, interval=slo_interval,
+            webhook=alert_webhook, source="fleet")
+        self.canary.start()
+        self.slo.start()
 
     def get_request(self):
         request, _ = super().get_request()
@@ -298,6 +323,8 @@ class FleetServer(socketserver.ThreadingMixIn,
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        self.slo.stop()
+        self.canary.stop()
         events.remove_global_sink(self._collector_sink)
         events.remove_global_sink(self._recorder_sink)
         self.scheduler.stop()
@@ -631,12 +658,87 @@ class FleetServer(socketserver.ThreadingMixIn,
                 fetched = list(pool.map(scrape, alive))
         else:
             fetched = []
-        parts = [metrics.render_prometheus()]
+        # makisu_worker_up: 1 iff this scrape round actually reached
+        # the worker — dead workers (never scraped) and alive-but-
+        # failed scrapes both read 0. Rendered from a throwaway
+        # registry so the gauge reflects THIS response, not a stale
+        # process-global value for a worker that vanished.
+        reachable = {w["id"] for w, text in fetched if text is not None}
+        up = metrics.MetricsRegistry()
+        for w in stats["workers"]:
+            up.gauge_set(metrics.WORKER_UP,
+                         1 if w["id"] in reachable else 0,
+                         worker=w["id"])
+        parts = [metrics.render_prometheus(),
+                 metrics.render_prometheus(up)]
         for w, text in fetched:
             if text is not None:
                 parts.append(metrics.relabel_prometheus(
                     text, worker=w["id"]))
         return metrics.merge_prometheus(parts)
+
+    def _slo_probe(self) -> dict:
+        """The fleet evaluator's sample: front-door build counters,
+        canary series, and scheduler-derived level signals. Every
+        input already exists — this just snapshots it."""
+        with self._mu:
+            ok, failed = self._done_ok, self._done_failed
+        counters: dict = {
+            "builds_started": float(ok + failed),
+            "builds_failed": float(failed),
+        }
+        counters.update(self.canary.counters())
+        stats = self.scheduler.stats()
+        alive = [w for w in stats["workers"] if w["alive"]]
+        version = stats["peer_map_version"]
+        acked = stats.get("peer_acked", {})
+        levels: dict = {
+            # Alive workers that have not acked the current peer map.
+            "peer_map_lag": float(sum(
+                1 for w in alive if acked.get(w["id"]) != version)),
+            "dead_workers": float(
+                len(stats["workers"]) - len(alive)),
+            "frontdoor_queue": float(stats["frontdoor_waiting"]),
+        }
+        levels.update(self.canary.levels())
+        return {"counters": counters, "levels": levels}
+
+    def alerts(self) -> dict:
+        """``GET /alerts``: the front door's own alert snapshot plus
+        every alive worker's, fanned out in parallel (same discipline
+        as /builds — one slow worker costs its own timeout)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from makisu_tpu.worker.client import WorkerClient
+        out = self.slo.manager.snapshot()
+        out["source"] = "fleet"
+        out["rules"] = [r.name for r in self.slo.rules]
+        out["canary"] = self.canary.status()
+        stats = self.scheduler.stats()
+        alive = [w for w in stats["workers"] if w["alive"]]
+
+        def fetch(w):
+            client = WorkerClient(w["socket"], connect_timeout=2.0,
+                                  control_timeout=5.0, retries=0)
+            try:
+                return w, client.alerts()
+            except (OSError, RuntimeError, ValueError):
+                return w, None
+
+        if alive:
+            with ThreadPoolExecutor(min(8, len(alive))) as pool:
+                fetched = list(pool.map(fetch, alive))
+        else:
+            fetched = []
+        workers: dict = {}
+        for w, payload in fetched:
+            workers[w["id"]] = (payload if payload is not None
+                                else {"error": "unreachable"})
+        for w in stats["workers"]:
+            if not w["alive"]:
+                workers[w["id"]] = {"error": "dead"}
+        out["workers"] = workers
+        return out
 
     def health(self) -> dict:
         """Worker-shaped ``/healthz`` (so ``top`` and WorkerClient
@@ -705,6 +807,7 @@ class FleetServer(socketserver.ThreadingMixIn,
                 "tenant_latency_seconds": {},
             },
             "fleet": stats,
+            "alerts": self.slo.manager.digest(),
             "self": self_section,
         }
 

@@ -191,6 +191,8 @@ class _Sampler(threading.Thread):
 
 
 def run(args) -> int:
+    if getattr(args, "slo_smoke", False):
+        return _run_slo_smoke(args)
     if getattr(args, "fleet", False):
         return _run_fleet(args)
     from makisu_tpu.worker import WorkerClient, WorkerServer
@@ -813,6 +815,330 @@ def _run_fleet(args) -> int:
           and baseline_results
           and report["fleet"]["digest_identity"])
     return 0 if ok else 1
+
+
+# -- SLO fault-injection smoke ----------------------------------------------
+
+
+def _run_slo_smoke(args) -> int:
+    """The SLO plane's acceptance scenario, end to end on real
+    surfaces (no test-only hooks):
+
+    1. A 3-worker fleet runs with fast canary sweeps and evaluation
+       ticks, plus a ``--slo-config`` that shrinks the
+       ``build_latency_burn`` windows to test time.
+    2. One worker is WEDGED by holding all of its admission slots —
+       the exact shape of a worker stuck behind a hung build. Its
+       canaries refuse instantly (no-wait admission), the burn-rate
+       alert must fire within two evaluation intervals, and the
+       ``makisu-tpu alerts`` render must name the rule.
+    3. Fresh contexts routed through the front door must land on the
+       healthy workers only, with ``health_demoted`` verdicts in the
+       route-decision ledger — and the healthy workers' canary layer
+       digests must be byte-identical.
+    4. The held slots are released; the alert must auto-resolve.
+
+    Alert transitions are captured off the event bus into an
+    alert-only NDJSON file (``--alert-events-out``) — the CI artifact.
+    Exit code is nonzero when any gate fails."""
+    from makisu_tpu.fleet import FleetServer, WorkerSpec
+    from makisu_tpu.fleet import peers as fleet_peers
+    from makisu_tpu.utils import events
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    n_workers = max(3, args.workers)
+    work_dir = args.work_dir or tempfile.mkdtemp(
+        prefix="makisu-slo-smoke-")
+    os.makedirs(work_dir, exist_ok=True)
+    cleanup_work = not args.work_dir
+    events_path = args.alert_events_out or os.path.join(
+        work_dir, "alerts.ndjson")
+
+    # Test-time cadence: canary sweeps and evaluation ticks well under
+    # a second, a shrunken fast window, and a slow window the run's
+    # since-oldest fallback keeps meaningful.
+    canary_interval = 0.75
+    slo_interval = 0.5
+    canary_slow_seconds = 5.0
+    fast_window = 3.0
+    slo_config = os.path.join(work_dir, "slo-smoke-rules.json")
+    metrics.write_json_atomic(slo_config, {"rules": [
+        {"name": "build_latency_burn",
+         "fast_window_seconds": fast_window,
+         "slow_window_seconds": 60.0},
+    ]})
+    # Two evaluation intervals, where one interval is a full canary
+    # sweep (its per-worker build budget) plus an evaluator tick.
+    fire_deadline = 2 * (canary_interval + canary_slow_seconds
+                         + slo_interval)
+
+    sink = events.JsonlWriter(events_path, event_types={"alert"})
+    events.add_global_sink(sink)
+    servers: dict[str, WorkerServer] = {}
+    fleet_server = None
+    held_slots = 0
+    victim = ""
+    slo: dict = {"rule": "build_latency_burn"}
+    gates: dict[str, bool] = {}
+
+    def front_alerts() -> dict:
+        try:
+            return json.loads(_front_get(
+                fleet_server.socket_path, "/alerts"))
+        except (OSError, ValueError):
+            return {}
+
+    def burn_active(snap: dict) -> dict | None:
+        for a in snap.get("active") or []:
+            if a.get("rule") == "build_latency_burn" \
+                    and a.get("label") == victim:
+                return a
+        return None
+
+    def wait_for(predicate, deadline_seconds: float) -> float | None:
+        """Poll the predicate; seconds it took, or None on timeout."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_seconds:
+            if predicate():
+                return time.monotonic() - t0
+            time.sleep(0.1)
+        return None
+
+    try:
+        specs = []
+        for i in range(n_workers):
+            wid = f"w{i}"
+            sock = os.path.join(work_dir, f"{wid}.sock")
+            # Bounded admission (2 slots) is the fault surface: the
+            # wedge holds every slot, and on healthy workers a canary
+            # and one routed build can coexist without a false refusal.
+            server = WorkerServer(sock, max_concurrent_builds=2)
+            server.serve_background()
+            servers[wid] = server
+            specs.append(WorkerSpec(
+                wid, sock, os.path.join(work_dir, f"{wid}-storage")))
+        for spec in specs:
+            client = WorkerClient(spec.socket_path)
+            deadline = time.monotonic() + args.ready_timeout
+            while not client.ready():
+                if time.monotonic() >= deadline:
+                    log.error("slo-smoke worker %s never became "
+                              "ready", spec.id)
+                    return 1
+                time.sleep(0.05)
+        fleet_server = FleetServer(
+            os.path.join(work_dir, "fleet.sock"), specs,
+            poll_interval=0.25,
+            slo_config=slo_config,
+            slo_interval=slo_interval,
+            canary_interval=canary_interval,
+            canary_slow_seconds=canary_slow_seconds)
+        fleet_server.serve_background()
+        front_client = WorkerClient(fleet_server.socket_path)
+        deadline = time.monotonic() + args.ready_timeout
+        while not front_client.ready():
+            if time.monotonic() >= deadline:
+                log.error("slo-smoke front door never became ready")
+                return 1
+            time.sleep(0.05)
+
+        # Healthy baseline: every worker has at least one clean canary
+        # (scores at 1.0, reference digests on disk) before the fault.
+        baselined = wait_for(
+            lambda: len([
+                row for row in (front_alerts().get("canary") or {})
+                .get("workers", {}).values()
+                if row.get("total", 0) >= 1 and row.get("ok")
+            ]) >= n_workers, 60.0)
+        if baselined is None:
+            log.error("slo-smoke: canaries never baselined")
+            return 1
+
+        # -- the fault: hold every admission slot on one worker.
+        victim = specs[0].id
+        t_wedge = time.monotonic()
+        for _ in range(2):
+            servers[victim]._admission.acquire()
+            held_slots += 1
+        slo["victim"] = victim
+
+        fired_after = wait_for(
+            lambda: burn_active(front_alerts()) is not None,
+            fire_deadline)
+        gates["fired_within_two_intervals"] = fired_after is not None
+        slo["fired_seconds"] = round(
+            time.monotonic() - t_wedge, 3) \
+            if fired_after is not None else None
+        slo["fire_deadline_seconds"] = round(fire_deadline, 3)
+
+        # The CLI surface, through the real subcommand handler.
+        import argparse
+        import contextlib
+        import io
+
+        from makisu_tpu import cli as cli_mod
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli_mod.cmd_alerts(argparse.Namespace(
+                socket=fleet_server.socket_path, json_out=False))
+        cli_render = buf.getvalue()
+        gates["cli_render_names_rule"] = \
+            "build_latency_burn" in cli_render
+        slo["cli_render"] = cli_render
+
+        # -- routing must shift away: fresh contexts (no affinity)
+        # driven sequentially so healthy workers never see a canary
+        # and two routed builds contend for the same two slots.
+        front = WorkerClient(fleet_server.socket_path)
+        routed: list[str] = []
+        failures = 0
+        for j in range(4):
+            ctx = os.path.join(work_dir, f"slo-ctx{j}")
+            _make_template(ctx, j, files=4, file_kb=2)
+            root = os.path.join(work_dir, f"slo-root{j}")
+            os.makedirs(root, exist_ok=True)
+            reg_token = metrics.set_build_registry(
+                metrics.MetricsRegistry())
+            try:
+                code = front.build(
+                    ["--log-level", "error", "build", ctx,
+                     "-t", f"slo-smoke/ctx{j}:latest",
+                     "--hasher", "cpu", "--root", root],
+                    tenant="default")
+            except (OSError, RuntimeError,
+                    http.client.HTTPException) as e:
+                code = -1
+                log.error("slo-smoke routed build %d failed: %s",
+                          j, e)
+            finally:
+                metrics.reset_build_registry(reg_token)
+            if code != 0:
+                failures += 1
+            terminal = front.last_build or {}
+            routed.append(str(terminal.get("worker", "")))
+        fleet_stats = json.loads(_front_get(
+            fleet_server.socket_path, "/fleet"))
+        demotions = [d for d in fleet_stats.get(
+            "recent_decisions", [])
+            if d.get("verdict") == "health_demoted"
+            and d.get("worker") == victim]
+        gates["builds_succeeded"] = failures == 0
+        gates["routing_shifted"] = (victim not in routed
+                                    and all(routed))
+        gates["health_demoted_recorded"] = (
+            int(fleet_stats.get("route_totals", {}).get(
+                "health_demoted", 0)) >= 1 and bool(demotions))
+        slo["routed_workers"] = routed
+        slo["health_demoted_decisions"] = len(demotions)
+        slo["route_totals"] = fleet_stats.get("route_totals", {})
+
+        # -- canary digest identity across the HEALTHY workers.
+        canary = front_alerts().get("canary") or {}
+        healthy_digests = {
+            tuple(row.get("digests") or ())
+            for wid, row in (canary.get("workers") or {}).items()
+            if wid != victim and row.get("ok")}
+        gates["digest_identity"] = (
+            not canary.get("digest_mismatch")
+            and len(healthy_digests) == 1
+            and () not in healthy_digests)
+        slo["canary"] = {
+            wid: {k: row.get(k) for k in
+                  ("score", "total", "bad", "ok")}
+            for wid, row in (canary.get("workers") or {}).items()}
+
+        # -- clear the fault; the alert must auto-resolve once the
+        # fast window drains and the resolve hysteresis clears.
+        while held_slots:
+            servers[victim]._admission.release()
+            held_slots -= 1
+        t_release = time.monotonic()
+        resolved_after = wait_for(
+            lambda: burn_active(front_alerts()) is None,
+            fast_window + 30.0)
+        gates["resolved_after_release"] = resolved_after is not None
+        slo["resolved_seconds"] = round(
+            time.monotonic() - t_release, 3) \
+            if resolved_after is not None else None
+    finally:
+        while held_slots:
+            servers[victim]._admission.release()
+            held_slots -= 1
+        if fleet_server is not None:
+            fleet_server.shutdown()
+            fleet_server.server_close()
+        for server in servers.values():
+            server.shutdown()
+            server.server_close()
+        fleet_peers.reset()
+        events.remove_global_sink(sink)
+        sink.close()
+
+    alert_events = events.read_jsonl(events_path, skip_invalid=True)
+    fired_events = [e for e in alert_events
+                    if e.get("rule") == "build_latency_burn"
+                    and e.get("state") == "firing"]
+    resolved_events = [e for e in alert_events
+                      if e.get("rule") == "build_latency_burn"
+                      and e.get("state") == "resolved"]
+    gates["alert_events_recorded"] = bool(fired_events) \
+        and bool(resolved_events)
+    slo["alert_events"] = {"total": len(alert_events),
+                           "fired": len(fired_events),
+                           "resolved": len(resolved_events),
+                           "path": events_path}
+    slo["gates"] = gates
+    report = {
+        "schema": LOADGEN_SCHEMA,
+        "mode": "slo-smoke",
+        "config": {
+            "workers": n_workers,
+            "canary_interval_seconds": canary_interval,
+            "slo_interval_seconds": slo_interval,
+            "canary_slow_seconds": canary_slow_seconds,
+            "fast_window_seconds": fast_window,
+        },
+        "slo": slo,
+        "ok": all(gates.values()),
+    }
+    if args.report:
+        metrics.write_json_atomic(args.report, report)
+        log.info("slo-smoke report written to %s", args.report)
+    print(render_slo_smoke(report), end="")
+    if cleanup_work:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
+def render_slo_smoke(report: dict) -> str:
+    """Human digest of an SLO smoke run: one line per gate, then the
+    timings the gates measured."""
+    slo = report.get("slo", {})
+    gates = slo.get("gates", {})
+    lines = [
+        f"slo-smoke: {'PASS' if report.get('ok') else 'FAIL'} "
+        f"({sum(1 for v in gates.values() if v)}/{len(gates)} gates) "
+        f"— victim {slo.get('victim', '?')}",
+    ]
+    for name, passed in sorted(gates.items()):
+        lines.append(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    if slo.get("fired_seconds") is not None:
+        lines.append(
+            f"  alert fired {slo['fired_seconds']:.1f}s after wedge "
+            f"(budget {slo.get('fire_deadline_seconds', 0):.1f}s)")
+    if slo.get("resolved_seconds") is not None:
+        lines.append(f"  alert resolved {slo['resolved_seconds']:.1f}s "
+                     f"after slot release")
+    if slo.get("routed_workers"):
+        lines.append("  routed to " + " ".join(slo["routed_workers"])
+                     + f"  (health_demoted × "
+                       f"{slo.get('health_demoted_decisions', 0)})")
+    ev = slo.get("alert_events") or {}
+    if ev:
+        lines.append(f"  alert events: {ev.get('fired', 0)} fired, "
+                     f"{ev.get('resolved', 0)} resolved → "
+                     f"{ev.get('path', '')}")
+    return "\n".join(lines) + "\n"
 
 
 def _front_get(socket_path: str, path: str) -> bytes:

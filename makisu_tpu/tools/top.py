@@ -46,7 +46,8 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
         + (f" ({len(stale)} stale ack(s))" if stale else ""),
         f"{'WORKER':<8s} {'STATE':<9s} {'ACTIVE':>6s} {'QUEUE':>6s} "
         f"{'SESS':>5s} {'POLL':>6s} {'PEERMAP':>8s} "
-        f"{'ROUTED':>7s} {'STORAGE':>8s}  SOCKET",
+        f"{'ROUTED':>7s} {'STORAGE':>8s} {'HEALTH':>6s} "
+        f"{'ALERTS':>6s}  SOCKET",
     ]
     from makisu_tpu.utils.traceexport import fmt_bytes
     for w in fleet.get("workers", []):
@@ -59,6 +60,14 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
         storage = w.get("storage") or {}
         stor = (fmt_bytes(storage.get("total_bytes", 0))
                 if storage else "-")
+        score = w.get("health_score")
+        health_part = f"{score:.2f}" if score is not None else "-"
+        digest = w.get("alerts") or {}
+        active_alerts = int(digest.get("active", 0) or 0)
+        # "2!" = two active alerts, at least one at page severity.
+        alerts_part = "-" if not active_alerts else (
+            f"{active_alerts}!" if int(digest.get("page", 0) or 0)
+            else f"{active_alerts}")
         lines.append(
             f"{_trunc(wid, 8):<8s} "
             f"{w.get('state', '?'):<9s} "
@@ -68,7 +77,9 @@ def _fleet_lines(fleet: dict, self_section: dict | None = None) -> list[str]:
             f"{_fmt_age(poll_age) if poll_age is not None else '-':>6s} "
             f"{peermap:>8s} "
             f"{w.get('routed_total', 0):>7d} "
-            f"{stor:>8s}  "
+            f"{stor:>8s} "
+            f"{health_part:>6s} "
+            f"{alerts_part:>6s}  "
             f"{_trunc(w.get('socket', ''), 36)}")
     totals = fleet.get("route_totals", {})
     if totals:

@@ -232,15 +232,25 @@ class JsonlWriter:
     concurrent writers a build spawns (cache pushes, chunk uploads,
     shell drains) can't interleave partial lines — a killed build
     leaves at worst one truncated FINAL line, and every line before it
-    stays valid JSON."""
+    stays valid JSON.
 
-    def __init__(self, path: str) -> None:
+    ``event_types`` optionally restricts the file to a set of event
+    types — how the SLO smoke scenario writes an alert-only NDJSON
+    artifact off the same bus the full event log rides."""
+
+    def __init__(self, path: str,
+                 event_types: "set[str] | None" = None) -> None:
         self.path = path
+        self.event_types = (set(event_types)
+                            if event_types is not None else None)
         self._f = open(path, "w", encoding="utf-8")
         self._lock = threading.Lock()
         self._closed = False
 
     def __call__(self, event: dict) -> None:
+        if self.event_types is not None \
+                and event.get("type") not in self.event_types:
+            return
         line = json.dumps(event, separators=(",", ":"), default=str)
         with self._lock:
             if self._closed:

@@ -287,6 +287,16 @@ def aggregate(records: list[dict]) -> dict:
     # routing change (warm affinity landing elsewhere, a failover-heavy
     # run) is topology, not code; `history diff` names it like the
     # device-route and warm-mode labels.
+    # Alerts fired during these builds' windows (records carry the
+    # per-invocation delta of makisu_alerts_fired_total). Summed, plus
+    # a per-record rate — the signal `history diff` uses to say "the
+    # candidate ran under an alert storm".
+    alert_counts = [int(r.get("alerts_fired", 0) or 0)
+                    for r in records if "alerts_fired" in r]
+    if alert_counts:
+        out["alerts_fired"] = sum(alert_counts)
+        out["alert_rate"] = round(
+            sum(alert_counts) / len(alert_counts), 4)
     via_fleet = [r for r in records if isinstance(r.get("fleet"), dict)]
     if records:
         out["routing"] = ("fleet" if len(via_fleet) * 2 > len(records)
@@ -378,6 +388,26 @@ def diff(a: list[dict], b: list[dict],
             **({"baseline_worker": dwa, "candidate_worker": dwb}
                if dwa != dwb and (dwa or dwb) else {}),
         }
+    # Alert-rate attribution: a candidate whose builds fired alerts
+    # where the baseline's fired none (or at a rate grown beyond the
+    # threshold) ran DEGRADED — SLO breaches during the measurement
+    # window explain latency swings the perf gates would otherwise
+    # pin on the code change. Named like the device-route/warm-mode/
+    # routing attributions; skipped when neither side carries the
+    # label (pre-SLO files).
+    aa = agg_a.get("alert_rate")
+    ab = agg_b.get("alert_rate")
+    if aa is not None or ab is not None:
+        aa_v = float(aa or 0.0)
+        ab_v = float(ab or 0.0)
+        grew = (ab_v > 0.0 and aa_v == 0.0) or (
+            aa_v > 0.0 and (ab_v - aa_v) / aa_v > threshold)
+        if grew:
+            result["alert_rate_change"] = {
+                "baseline": aa_v, "candidate": ab_v,
+                "baseline_fired": int(agg_a.get("alerts_fired", 0)),
+                "candidate_fired": int(agg_b.get("alerts_fired", 0)),
+            }
     # Storage-growth gate: a content plane that grew beyond the
     # threshold between baseline and candidate is a retention leak the
     # perf gates can't see (the build got no slower — the disk just
@@ -498,6 +528,15 @@ def render_diff(result: dict) -> str:
         lines.append(
             f"  routing mix: {detail}  (latency deltas may be fleet "
             f"placement, not code)")
+    alert_change = result.get("alert_rate_change")
+    if alert_change:
+        lines.append(
+            f"  alert rate: {alert_change['baseline']:g} → "
+            f"{alert_change['candidate']:g} fired/build "
+            f"({alert_change['baseline_fired']} → "
+            f"{alert_change['candidate_fired']} total)  (candidate "
+            f"ran under SLO alerts — latency deltas may be a degraded "
+            f"fleet, not code)")
     growth = result.get("storage_growth") or []
     for g in growth:
         lines.append(

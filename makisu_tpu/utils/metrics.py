@@ -172,6 +172,22 @@ STORAGE_SCRUB_CHUNKS = "makisu_storage_scrub_chunks_total"
 STORAGE_SCRUB_BYTES = "makisu_storage_scrub_bytes_total"
 STORAGE_SCRUB_CORRUPT = "makisu_storage_scrub_corrupt_total"
 
+# Fleet SLO plane (fleet/slo.py + utils/alerts.py): alert lifecycle
+# counters (labeled rule/severity), the active-alert gauge a threshold
+# rule or dashboard reads directly, webhook delivery outcomes
+# (result=ok|error), synthetic canary build outcomes
+# (worker + result=ok|error) and latency, the per-worker health score
+# the scheduler's demotion reads, and the scrape-fan-out liveness
+# gauge (1/0 per worker) on the aggregated fleet /metrics.
+ALERTS_FIRED = "makisu_alerts_fired_total"
+ALERTS_RESOLVED = "makisu_alerts_resolved_total"
+ALERT_ACTIVE = "makisu_alert_active"
+ALERT_WEBHOOK = "makisu_alert_webhook_total"
+CANARY_BUILDS = "makisu_canary_builds_total"
+CANARY_LATENCY = "makisu_canary_latency_seconds"
+WORKER_HEALTH_SCORE = "makisu_worker_health_score"
+WORKER_UP = "makisu_worker_up"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.

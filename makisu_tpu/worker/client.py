@@ -435,10 +435,23 @@ class WorkerClient:
         finally:
             conn.close()
 
+    def alerts(self) -> dict:
+        """The ``GET /alerts`` payload: active + recently-resolved
+        SLO alerts (worker or fleet server — both speak the same
+        ``makisu-tpu.alert.v1`` shape)."""
+        conn, resp = self._control("/alerts")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /alerts returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
     def build(self, argv: list[str],
               context_dir: str | None = None,
               on_line=None, on_event=None,
-              tenant: str = "") -> int:
+              tenant: str = "", no_wait: bool = False) -> int:
         """Submit a build; stream log lines to the local logger (and
         ``on_line(payload)`` when given); return the worker's build exit
         code.
@@ -450,7 +463,13 @@ class WorkerClient:
         ``queue_wait_seconds`` + ``tenant``, see ``last_build``).
 
         ``tenant`` labels this build in the worker's queue/latency
-        telemetry (sent as the ``X-Makisu-Tenant`` header)."""
+        telemetry (sent as the ``X-Makisu-Tenant`` header).
+        ``no_wait`` asks for cooperative admission refusal (the fleet
+        forwarder's ``X-Makisu-No-Wait``): a saturated worker answers
+        503 immediately — surfaced here as the ``RuntimeError`` the
+        non-200 path already raises — instead of queueing the build.
+        The canary driver probes with it so a wedged worker reads as
+        an instant failure, not a piled-up queue."""
         if context_dir is not None:
             worker_ctx = self.prepare_context(context_dir)
             argv = list(argv) + [worker_ctx]
@@ -466,6 +485,8 @@ class WorkerClient:
         headers = {}
         if metrics.has_trace_context():
             headers["traceparent"] = metrics.current_traceparent()
+        if no_wait:
+            headers["X-Makisu-No-Wait"] = "1"
         conn, resp = self._request(
             "POST", "/build", json.dumps(argv).encode(),
             tenant=tenant, headers=headers)

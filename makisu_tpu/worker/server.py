@@ -403,6 +403,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "version": fleet_peers.map_version(),
                 "peers": list(fleet_peers.peers()),
             }).encode(), content_type="application/json")
+        elif self.path == "/alerts":
+            # SLO plane: active + recently-resolved alerts from this
+            # worker's rule evaluator (fleet/slo.py) — what doctor,
+            # top, and `makisu-tpu alerts` render.
+            self._respond(200,
+                          json.dumps(self.server.alerts()).encode(),
+                          content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -666,7 +673,10 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     def __init__(self, socket_path: str,
                  stall_window: float | None = None,
                  diag_out: str = "",
-                 max_concurrent_builds: int = 0) -> None:
+                 max_concurrent_builds: int = 0,
+                 slo_config: str = "",
+                 alert_webhook: str = "",
+                 slo_interval: float | None = None) -> None:
         if os.path.exists(socket_path):
             os.unlink(socket_path)
         super().__init__(socket_path, _Handler)
@@ -787,6 +797,22 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 # whose trace filter would drop every build's spans.
                 registry=metrics.global_registry(),
                 active_fn=lambda: self._active_builds() > 0).start()
+        # SLO plane: a background rule evaluator over this worker's
+        # existing vitals (quantile rings, health counters, census
+        # digest, device probe, progress clock — no new sampling).
+        # Firing/resolved alerts ride the event bus (into the flight
+        # recorder's ring for free), GET /alerts serves the ring, and
+        # /healthz carries a cheap active-count digest. Interval 0 (or
+        # MAKISU_TPU_SLO_INTERVAL_SECONDS=0) disables evaluation;
+        # the endpoint still answers with an empty payload.
+        from makisu_tpu.fleet import slo as slo_mod
+        rules = slo_mod.default_worker_rules()
+        if slo_config:
+            rules = slo_mod.load_rules(slo_config, rules)
+        self.slo = slo_mod.SloEvaluator(
+            self._slo_probe, rules, interval=slo_interval,
+            webhook=alert_webhook, source="worker")
+        self.slo.start()
 
     # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
     # wants a (host, port) tuple for logging.
@@ -1236,6 +1262,74 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             return (self._builds_started - self._builds_succeeded
                     - self._builds_failed)
 
+    def _slo_probe(self) -> dict:
+        """The SLO evaluator's sample — every input is a surface this
+        server already keeps (no new sampling): outcome counters for
+        the burn-rate rules, and ring/probe/census levels for the
+        threshold rules."""
+        from makisu_tpu.utils import flightrecorder
+        with self._health_mu:
+            started = self._builds_started
+            succeeded = self._builds_succeeded
+            failed = self._builds_failed
+        active = started - succeeded - failed
+        latency = self._latency_ring.stats()
+        wait = self._queue_wait_ring.stats()
+        with self._builds_mu:
+            tenant_rings = dict(self._tenant_latency)
+        tenant_p99 = {t: float(ring.stats().get("p99", 0.0))
+                      for t, ring in tenant_rings.items()}
+        # Queue-wait share: how much of the typical build's wall clock
+        # was admission queueing (p50-over-p50 — medians, so one
+        # outlier can't claim the whole fleet is queue-bound).
+        share = 0.0
+        if latency.get("count") and latency.get("p50"):
+            share = float(wait.get("p50", 0.0)) / float(latency["p50"])
+        # Device probe verdict — consulted only when something already
+        # imported the device stack (same gate as health()).
+        device_bad = 0.0
+        ops_backend = sys.modules.get("makisu_tpu.ops.backend")
+        if ops_backend is not None:
+            try:
+                state = str(ops_backend.device_health()
+                            .get("probe", {}).get("state", ""))
+            except Exception as exc:  # noqa: BLE001
+                # A probe that can't even answer IS the page signal.
+                from makisu_tpu.utils import logging as log
+                log.debug("device health probe failed: %s", exc)
+                state = "error"
+            device_bad = 1.0 if state in ("wedged", "failed",
+                                          "error") else 0.0
+        # Progress age counts only while builds are active: an idle
+        # worker emitting nothing is healthy, not stalled.
+        progress_age = (flightrecorder.last_progress_seconds()
+                        if active > 0 else 0.0)
+        storage_bytes = float(
+            self.storage_health().get("total_bytes", 0) or 0)
+        return {
+            "counters": {
+                "builds_started": float(started),
+                "builds_failed": float(failed),
+            },
+            "levels": {
+                "build_latency_p99": float(latency.get("p99", 0.0)),
+                "tenant_latency_p99": tenant_p99,
+                "queue_wait_share": round(share, 4),
+                "queue_depth": float(self._admission.depth()),
+                "progress_age": progress_age,
+                "device_probe_bad": device_bad,
+                "storage_total_bytes": storage_bytes,
+            },
+        }
+
+    def alerts(self) -> dict:
+        """The ``GET /alerts`` payload: the alert ring plus the rule
+        names this worker evaluates."""
+        out = self.slo.manager.snapshot()
+        out["source"] = "worker"
+        out["rules"] = [r.name for r in self.slo.rules]
+        return out
+
     def health(self) -> dict:
         """The ``GET /healthz`` payload: uptime, build outcome counts
         (active = started - finished; a build blocked on a shared
@@ -1361,10 +1455,15 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             # dead) answers 0 here, telling the scheduler its map was
             # lost and must be republished.
             "peer_map_version": _peer_map_version(),
+            # SLO-plane digest: active alert counts by severity — the
+            # cheap signal the fleet poll captures for `top`'s ALERTS
+            # column. Full rows live on GET /alerts.
+            "alerts": self.slo.manager.digest(),
         }
 
     def server_close(self) -> None:
         from makisu_tpu.utils import events
+        self.slo.stop()
         self._scrub_stop.set()
         if self._watchdog is not None:
             self._watchdog.stop()

@@ -1,0 +1,421 @@
+"""SLO plane: burn-rate math, declarative rules, the alert state
+machine, evaluator ticks, health-demoted routing, and the surfaces
+(doctor findings, history attribution, top's ALERTS column) — all as
+pure-function tests over canned inputs."""
+
+import json
+
+import pytest
+
+from makisu_tpu.fleet import doctor as fleet_doctor
+from makisu_tpu.fleet import slo
+from makisu_tpu.fleet.scheduler import FleetScheduler, WorkerSpec
+from makisu_tpu.utils import alerts as alerts_mod
+from makisu_tpu.utils import history
+
+
+# -- window_delta / burn_rate ------------------------------------------------
+
+
+def test_window_delta_empty_and_single_sample_are_none():
+    assert slo.window_delta([], 60.0) is None
+    assert slo.window_delta([(0.0, 5.0)], 60.0) is None
+
+
+def test_window_delta_uses_baseline_at_window_start():
+    samples = [(0.0, 0.0), (30.0, 3.0), (60.0, 5.0), (90.0, 9.0)]
+    # Window [30, 90]: baseline is the sample AT the window start.
+    assert slo.window_delta(samples, 60.0, now=90.0) == 6.0
+
+
+def test_window_delta_partial_window_falls_back_to_oldest():
+    # Ring spans 10s, window asks for an hour: delta since oldest —
+    # a fresh process can alert before an hour of history exists.
+    samples = [(0.0, 1.0), (10.0, 4.0)]
+    assert slo.window_delta(samples, 3600.0, now=10.0) == 3.0
+
+
+def test_window_delta_counter_reset_clamps_to_zero():
+    # Worker restart: the cumulative counter went backwards. That is
+    # not a negative burn.
+    samples = [(0.0, 100.0), (10.0, 2.0)]
+    assert slo.window_delta(samples, 60.0, now=10.0) == 0.0
+
+
+def test_burn_rate_none_when_denominator_flat():
+    num = [(0.0, 0.0), (10.0, 5.0)]
+    den = [(0.0, 7.0), (10.0, 7.0)]  # no traffic: 0/0 is not 100% bad
+    assert slo.burn_rate(num, den, 60.0, now=10.0) is None
+
+
+def test_burn_rate_ratio():
+    num = [(0.0, 0.0), (10.0, 1.0)]
+    den = [(0.0, 0.0), (10.0, 4.0)]
+    assert slo.burn_rate(num, den, 60.0, now=10.0) == 0.25
+
+
+# -- multi_window_breach -----------------------------------------------------
+
+
+def _ramp(bad_per_tick, total_per_tick, ticks, step=1.0):
+    num, den, b, t = [], [], 0.0, 0.0
+    for i in range(ticks):
+        b += bad_per_tick
+        t += total_per_tick
+        num.append((i * step, b))
+        den.append((i * step, t))
+    return num, den
+
+
+def test_multi_window_breach_exact_threshold_fires():
+    num, den = _ramp(1, 2, 10)
+    breached, fast, slow = slo.multi_window_breach(
+        num, den, fast_window=3.0, slow_window=9.0,
+        threshold=0.5, now=9.0)
+    assert fast == 0.5 and slow == 0.5
+    assert breached  # >= — exact threshold is out of budget
+
+
+def test_multi_window_breach_needs_both_windows():
+    # Old samples are clean; only the last 2 ticks burn. The fast
+    # window sees the burn, the slow window dilutes it below
+    # threshold — no page for a blip.
+    num = [(float(i), 0.0) for i in range(8)] + [(8.0, 1.0), (9.0, 2.0)]
+    den = [(float(i), float(2 * i)) for i in range(10)]
+    breached, fast, slow = slo.multi_window_breach(
+        num, den, fast_window=2.0, slow_window=9.0,
+        threshold=0.5, now=9.0)
+    assert fast is not None and fast >= 0.5
+    assert slow is not None and slow < 0.5
+    assert not breached
+
+
+def test_multi_window_breach_no_data_is_not_an_outage():
+    breached, fast, slow = slo.multi_window_breach(
+        [], [], 300.0, 3600.0, 0.5)
+    assert not breached and fast is None and slow is None
+
+
+# -- rules -------------------------------------------------------------------
+
+
+def test_rule_validation_rejects_bad_fields():
+    with pytest.raises(ValueError):
+        slo.SloRule("r", "nope", signal="x")
+    with pytest.raises(ValueError):
+        slo.SloRule("r", "level", signal="x", severity="critical")
+    with pytest.raises(ValueError):
+        slo.SloRule("r", "level", signal="x", op="gt")
+    with pytest.raises(ValueError):
+        slo.SloRule("r", "burn_rate", numerator="a")  # no denominator
+    with pytest.raises(ValueError):
+        slo.SloRule("r", "level")  # no signal
+
+
+def test_rule_roundtrips_through_dict():
+    rule = slo.SloRule("burn", "burn_rate", severity="page",
+                       threshold=0.5, numerator="bad",
+                       denominator="total", fast_window=30.0,
+                       slow_window=600.0, message="m")
+    again = slo.SloRule.from_dict(rule.to_dict())
+    assert again.to_dict() == rule.to_dict()
+    assert again.fast_window == 30.0 and again.slow_window == 600.0
+
+
+def test_load_rules_merges_disables_and_adds(tmp_path):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rules": [
+        # Override one built-in field; the rest (numerator, windows)
+        # must survive the merge.
+        {"name": "build_error_burn", "threshold": 0.9},
+        {"name": "storage_budget", "disabled": True},
+        {"name": "custom_queue", "kind": "level",
+         "signal": "queue_depth", "threshold": 3.0},
+    ]}))
+    rules = {r.name: r for r in slo.load_rules(
+        str(path), slo.default_worker_rules())}
+    assert rules["build_error_burn"].threshold == 0.9
+    assert rules["build_error_burn"].numerator == "builds_failed"
+    assert "storage_budget" not in rules
+    assert rules["custom_queue"].signal == "queue_depth"
+
+
+def test_load_rules_rejects_malformed(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"rules": [{"kind": "level"}]}))
+    with pytest.raises(ValueError):
+        slo.load_rules(str(path))
+    path.write_text(json.dumps({"rules": "nope"}))
+    with pytest.raises(ValueError):
+        slo.load_rules(str(path))
+
+
+def test_default_rules_are_internally_valid():
+    for rule in slo.default_worker_rules() + slo.default_fleet_rules():
+        # from_dict(to_dict) re-runs every validation.
+        slo.SloRule.from_dict(rule.to_dict())
+
+
+# -- AlertManager ------------------------------------------------------------
+
+
+def test_alert_fires_immediately_and_resolves_with_hysteresis():
+    mgr = alerts_mod.AlertManager(resolve_after=2)
+    assert mgr.observe("r", True, severity="page") == "fired"
+    assert mgr.observe("r", True) is None  # steady firing
+    assert mgr.observe("r", False) is None  # first clear: suppressed
+    assert mgr.observe("r", False) == "resolved"
+    assert mgr.active() == []
+    assert mgr.recent()[0]["rule"] == "r"
+
+
+def test_alert_flap_does_not_resolve():
+    mgr = alerts_mod.AlertManager(resolve_after=2)
+    mgr.observe("r", True)
+    # clear, breach, clear, clear — the mid-flap breach must reset the
+    # clear streak, so only the LAST two consecutive clears resolve.
+    assert mgr.observe("r", False) is None
+    assert mgr.observe("r", True) is None
+    assert mgr.observe("r", False) is None
+    assert mgr.observe("r", False) == "resolved"
+    # fire_count stays 1: the flap never fully resolved in between.
+    assert mgr.recent()[0]["fire_count"] == 1
+
+
+def test_alert_clear_without_fire_creates_no_state():
+    mgr = alerts_mod.AlertManager()
+    assert mgr.observe("r", False) is None
+    assert mgr.snapshot()["counts"]["active"] == 0
+    assert mgr.digest() == {"active": 0, "page": 0, "warn": 0}
+
+
+def test_alert_snapshot_counts_and_digest():
+    mgr = alerts_mod.AlertManager()
+    mgr.observe("p", True, severity="page", label="w0")
+    mgr.observe("w", True, severity="warn")
+    snap = mgr.snapshot()
+    assert snap["counts"] == {"active": 2, "page": 1, "warn": 1}
+    # Severity-major order: the page alert leads.
+    assert snap["active"][0]["rule"] == "p"
+    assert snap["active"][0]["label"] == "w0"
+    assert mgr.digest() == {"active": 2, "page": 1, "warn": 1}
+
+
+def test_render_alerts_names_rules_and_labels():
+    mgr = alerts_mod.AlertManager()
+    mgr.observe("burn", True, severity="page", label="w1",
+                value=1.0, threshold=0.5, message="burning")
+    text = alerts_mod.render_alerts(mgr.snapshot(), heading="h")
+    assert "burn[w1]" in text and "[page]" in text
+    assert "value 1 vs threshold 0.5" in text
+    assert "no active alerts" in alerts_mod.render_alerts(
+        alerts_mod.AlertManager().snapshot())
+
+
+# -- SloEvaluator ------------------------------------------------------------
+
+
+def test_evaluator_burn_rule_fires_per_label():
+    probes = []
+
+    def probe():
+        return probes.pop(0)
+
+    rule = slo.SloRule("burn", "burn_rate", severity="page",
+                       threshold=0.5, numerator="bad",
+                       denominator="total",
+                       fast_window=10.0, slow_window=30.0)
+    ev = slo.SloEvaluator(probe, [rule], interval=0)
+    for tick, (bad_w0, bad_w1, total) in enumerate(
+            [(0, 0, 1), (1, 0, 2), (2, 0, 3)]):
+        probes.append({"counters": {
+            "bad": {"w0": float(bad_w0), "w1": float(bad_w1)},
+            "total": {"w0": float(total), "w1": float(total)},
+        }})
+        ev.tick(now=float(tick))
+    active = ev.manager.active()
+    assert [a["label"] for a in active] == ["w0"]
+    assert active[0]["rule"] == "burn"
+
+
+def test_evaluator_level_rule_breach_for_hysteresis():
+    levels = {"depth": 9.0}
+    rule = slo.SloRule("q", "level", signal="depth", threshold=5.0,
+                       breach_for=2)
+    ev = slo.SloEvaluator(lambda: {"levels": levels}, [rule],
+                          interval=0)
+    ev.tick(now=0.0)
+    assert ev.manager.active() == []  # one breached tick: not yet
+    ev.tick(now=1.0)
+    assert [a["rule"] for a in ev.manager.active()] == ["q"]
+    # A non-consecutive breach must not fire.
+    ev2 = slo.SloEvaluator(lambda: {"levels": levels}, [rule],
+                           interval=0)
+    ev2.tick(now=0.0)
+    levels["depth"] = 0.0
+    ev2.tick(now=1.0)
+    levels["depth"] = 9.0
+    ev2.tick(now=2.0)
+    assert ev2.manager.active() == []
+
+
+def test_evaluator_le_rule_and_vanished_label_clears():
+    scores = {"canary_health_score": {"w0": 0.3}}
+    rule = slo.SloRule("health", "level", severity="page",
+                       signal="canary_health_score", op="le",
+                       threshold=0.5)
+    ev = slo.SloEvaluator(lambda: {"levels": dict(scores)}, [rule],
+                          manager=alerts_mod.AlertManager(
+                              resolve_after=1),
+                          interval=0)
+    ev.tick(now=0.0)
+    assert [a["label"] for a in ev.manager.active()] == ["w0"]
+    # The worker disappears from the probe (removed from the fleet):
+    # the firing alert must clear, not live forever.
+    scores.clear()
+    ev.tick(now=1.0)
+    assert ev.manager.active() == []
+
+
+def test_evaluator_probe_failure_never_raises():
+    def probe():
+        raise RuntimeError("probe died")
+
+    ev = slo.SloEvaluator(probe, slo.default_fleet_rules(), interval=0)
+    ev.tick(now=0.0)  # must not raise
+    assert ev.manager.active() == []
+
+
+# -- health-demoted routing --------------------------------------------------
+
+
+def _sched(n=3):
+    specs = [WorkerSpec(f"w{i}", f"/tmp/w{i}.sock") for i in range(n)]
+    sched = FleetScheduler(specs)
+    for state in sched.workers.values():
+        state.alive = True
+    return sched
+
+
+def test_route_demotes_unhealthy_worker():
+    sched = _sched()
+    sched.set_health_score("w1", 0.2)
+    for key in ("ctx-a", "ctx-b", "ctx-c", "ctx-d", "ctx-e"):
+        worker, _verdict, _ = sched.route(key)
+        assert worker.spec.id != "w1"
+    totals = sched.stats()["route_totals"]
+    assert totals.get("health_demoted", 0) >= 1
+    demoted = [d for d in sched.stats()["recent_decisions"]
+               if d.get("verdict") == "health_demoted"]
+    assert demoted and demoted[0]["worker"] == "w1"
+    assert demoted[0]["reason"] == "canary_health"
+
+
+def test_route_affinity_beats_health_demotion():
+    sched = _sched()
+    worker, _, _ = sched.route("ctx-sticky")
+    holder = worker.spec.id
+    sched.workers[holder].sessions = {"ctx-sticky"}
+    sched.set_health_score(holder, 0.0)
+    again, verdict, _ = sched.route("ctx-sticky")
+    # Warm state wins: affinity routes back even at score 0.
+    assert again.spec.id == holder and verdict == "affinity"
+
+
+def test_route_all_unhealthy_still_routes():
+    sched = _sched()
+    for wid in list(sched.workers):
+        sched.set_health_score(wid, 0.1)
+    worker, _, _ = sched.route("ctx-any")
+    assert worker is not None  # degraded beats NoWorkersError
+    # No demotion recorded: an all-unhealthy fleet routes normally
+    # (the decision ring is per-scheduler, unlike the global counter).
+    assert not [d for d in sched.stats()["recent_decisions"]
+                if d.get("verdict") == "health_demoted"]
+
+
+def test_health_score_clamped_and_snapshotted():
+    sched = _sched(1)
+    sched.set_health_score("w0", 7.5)
+    assert sched.health_scores()["w0"] == 1.0
+    sched.set_health_score("w0", -3.0)
+    snap = sched.stats()["workers"][0]
+    assert snap["health_score"] == 0.0
+
+
+# -- doctor / history / top surfaces -----------------------------------------
+
+
+def test_doctor_alert_findings_map_severities():
+    findings = fleet_doctor.alert_findings({
+        "active": [{"rule": "fleet_error_burn", "severity": "page",
+                    "value": 1.0, "threshold": 0.5,
+                    "message": "burning"}],
+        "workers": {"w0": {"active": [
+            {"rule": "queue_wait_share", "severity": "warn",
+             "label": "tenant-a", "message": "queueing"}]}},
+    })
+    assert findings[0]["severity"] == "error"
+    assert findings[0]["kind"] == "alert"
+    worker_tagged = [f for f in findings if f["worker"] == "w0"]
+    assert worker_tagged and worker_tagged[0]["severity"] == "warning"
+    assert "queue_wait_share[tenant-a]" in worker_tagged[0]["detail"]
+    assert fleet_doctor.alert_findings(None) == []
+
+
+def test_doctor_fleet_uses_healthz_digest_without_alerts():
+    health = {"fleet": {"workers": [
+        {"id": "w0", "alive": True,
+         "alerts": {"active": 2, "page": 1, "warn": 1}},
+        {"id": "w1", "alive": True, "alerts": {"active": 0}},
+    ]}}
+    findings = fleet_doctor.diagnose_fleet(health)
+    alert_rows = [f for f in findings if f["kind"] == "alert"]
+    assert len(alert_rows) == 1 and alert_rows[0]["worker"] == "w0"
+    assert alert_rows[0]["severity"] == "error"  # a page is active
+    # With the full /alerts payload supplied, the digest fallback
+    # stays silent and the payload's findings lead.
+    findings = fleet_doctor.diagnose_fleet(
+        health, alerts={"active": [
+            {"rule": "r", "severity": "info", "message": "m"}]})
+    alert_rows = [f for f in findings if f["kind"] == "alert"]
+    assert len(alert_rows) == 1 and alert_rows[0]["rule"] == "r"
+
+
+def test_history_aggregate_and_diff_alert_attribution():
+    base = [{"duration_seconds": 1.0, "exit_code": 0,
+             "alerts_fired": 0} for _ in range(4)]
+    cand = [{"duration_seconds": 1.0, "exit_code": 0,
+             "alerts_fired": 2} for _ in range(4)]
+    agg = history.aggregate(cand)
+    assert agg["alerts_fired"] == 8 and agg["alert_rate"] == 2.0
+    result = history.diff(base, cand)
+    change = result["alert_rate_change"]
+    assert change["candidate_fired"] == 8
+    # Attribution, not a gate: alerts explain a latency delta, they
+    # are not themselves a regression verdict.
+    assert result["ok"]
+    assert "ran under SLO alerts" in history.render_diff(result)
+    # Pre-SLO files (no label anywhere) skip the attribution.
+    old = [{"duration_seconds": 1.0, "exit_code": 0}] * 4
+    assert "alert_rate_change" not in history.diff(old, old)
+
+
+def test_top_fleet_lines_show_alerts_column():
+    from makisu_tpu.tools.top import _fleet_lines
+    lines = _fleet_lines({"workers": [
+        {"id": "w0", "state": "alive", "active_builds": 0,
+         "queue_depth": 0, "sessions": [], "routed_total": 1,
+         "socket": "/tmp/w0.sock", "health_score": 0.36,
+         "alerts": {"active": 2, "page": 1, "warn": 1}},
+        {"id": "w1", "state": "alive", "active_builds": 0,
+         "queue_depth": 0, "sessions": [], "routed_total": 1,
+         "socket": "/tmp/w1.sock", "health_score": 1.0,
+         "alerts": {}},
+    ]})
+    header = next(l for l in lines if "WORKER" in l)
+    assert "ALERTS" in header and "HEALTH" in header
+    w0 = next(l for l in lines if l.startswith("w0"))
+    assert "2!" in w0 and "0.36" in w0  # page marker + score
+    w1 = next(l for l in lines if l.startswith("w1"))
+    assert " - " in w1 and "1.00" in w1
