@@ -394,6 +394,10 @@ class StorageCensus:
         self.packs_dir = os.path.join(serve, "packs")
         self.zpacks_dir = os.path.join(serve, "zpacks")
         self.recipes_dir = os.path.join(serve, "recipes")
+        # Session-snapshot recipes (worker/snapshots.py): accounted as
+        # an occupant of the CHUNK plane — their shard bytes live in
+        # the chunk CAS, the recipe JSON is just the plan over them.
+        self.snapshots_dir = os.path.join(serve, "snapshots")
 
     # -- plane walks ------------------------------------------------------
 
@@ -504,6 +508,37 @@ class StorageCensus:
                     path=path, object=layer_hex))
                 continue
             docs[layer_hex] = doc
+        return docs, findings
+
+    def _load_snapshot_recipes(self) -> tuple[dict[str, dict],
+                                              list[dict]]:
+        """Parse every session-snapshot recipe
+        (``serve/snapshots/<snap_key>.json``); torn/malformed ones are
+        ``corrupt_index`` findings, never crashes — same discipline as
+        layer recipes. Returns ``{snap_key: doc}``."""
+        docs: dict[str, dict] = {}
+        findings: list[dict] = []
+        for name, size, _ in self._walk_flat(self.snapshots_dir,
+                                             ".json"):
+            key = name[:-len(".json")]
+            if not is_hex_digest(key):
+                continue
+            path = os.path.join(self.snapshots_dir, name)
+            try:
+                with self.budget.reserve(size):
+                    with open(path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                self.budget.throttle(size)
+                if not isinstance(doc, dict) \
+                        or not isinstance(doc.get("shards"), dict):
+                    raise ValueError("not a snapshot recipe")
+            except (OSError, ValueError, TypeError):
+                findings.append(make_finding(
+                    "corrupt_index", "error", "chunks",
+                    f"session snapshot {key[:12]} is torn or "
+                    f"malformed", path=path, object=key))
+                continue
+            docs[key] = doc
         return docs, findings
 
     def _load_pack_tables(self) -> tuple[
@@ -635,15 +670,24 @@ class StorageCensus:
         table_rows = self._walk_flat(self.packs_dir, ".json")
         zpack_rows = self._walk_flat(self.zpacks_dir, ".zst")
         recipe_rows = self._walk_flat(self.recipes_dir, ".json")
+        snapshot_rows = self._walk_flat(self.snapshots_dir, ".json")
         manifest_rows = self._walk_manifests()
 
         packs_stats = self._plane_stats(table_rows + zpack_rows, now)
         packs_stats["tables"] = len(table_rows)
         packs_stats["zpacks"] = len(zpack_rows)
         packs_stats["zpack_bytes"] = sum(s for _, s, _ in zpack_rows)
+        # Session-snapshot recipes join the CHUNK plane's accounting
+        # (their shard bytes already live in the chunk CAS; the recipe
+        # JSON is the plan over them) with sub-counters so `du` and
+        # /storage can attribute the occupancy.
+        chunks_stats = self._plane_stats(chunks + snapshot_rows, now)
+        chunks_stats["snapshots"] = len(snapshot_rows)
+        chunks_stats["snapshot_bytes"] = sum(
+            s for _, s, _ in snapshot_rows)
         planes = {
             "blobs": self._plane_stats(blobs, now),
-            "chunks": self._plane_stats(chunks, now),
+            "chunks": chunks_stats,
             "packs": packs_stats,
             "recipes": self._plane_stats(recipe_rows, now),
         }
@@ -686,7 +730,9 @@ class StorageCensus:
         findings: list[dict] = []
         recipes, recipe_findings = self._load_recipes()
         tables, table_findings = self._load_pack_tables()
-        findings += recipe_findings + table_findings
+        snapshots, snapshot_findings = self._load_snapshot_recipes()
+        findings += recipe_findings + table_findings \
+            + snapshot_findings
 
         chunk_rows = self._walk_cas(self.chunks_dir)
         chunk_names = {n for n, _, _ in chunk_rows}
@@ -789,6 +835,39 @@ class StorageCensus:
                 object=pack_hex, bytes=size, repairable=True,
                 path=os.path.join(self.zpacks_dir, name))
 
+        # session snapshot → shard chunks. A snapshot whose chunks
+        # were evicted from under it is ORPHANED (restore will refuse
+        # with chunks_unavailable; the recipe is reclaimable garbage),
+        # classified and itemized — never a crash. Intact snapshots
+        # keep their shard chunks LIVE, so chunk-plane eviction
+        # accounting sees warm-state bytes as referenced occupants.
+        orphaned_snapshots: set[str] = set()
+        orphaned_snapshot_bytes = 0
+        snapshot_sizes: dict[str, int] = {}
+        for key, doc in snapshots.items():
+            path = os.path.join(self.snapshots_dir, f"{key}.json")
+            try:
+                snapshot_sizes[key] = os.path.getsize(path)
+            except OSError:
+                snapshot_sizes[key] = 0
+            for name, row in sorted(doc.get("shards", {}).items()):
+                fp = str((row or {}).get("chunk", "")) \
+                    if isinstance(row, dict) else ""
+                if not is_hex_digest(fp):
+                    continue
+                referenced_chunks.add(fp)
+                if fp not in chunk_names \
+                        and key not in orphaned_snapshots:
+                    orphaned_snapshots.add(key)
+                    orphaned_snapshot_bytes += snapshot_sizes[key]
+                    add("orphaned_snapshot", "warning", "chunks",
+                        f"session snapshot {key[:12]} references "
+                        f"evicted chunk {fp[:12]} (shard {name}); "
+                        f"restore would refuse — recipe is "
+                        f"reclaimable",
+                        object=key, chunk=fp, path=path,
+                        context=str(doc.get("context", "")))
+
         # manifest → blob
         manifest_refs, _ = self._manifest_refs()
         for hx in sorted(manifest_refs - blob_names):
@@ -846,6 +925,12 @@ class StorageCensus:
                 "orphaned": 0,
                 "orphaned_bytes": 0,
                 "dangling": len(dangling_recipes),
+            },
+            "snapshots": {
+                "live": len(snapshots) - len(orphaned_snapshots),
+                "orphaned": len(orphaned_snapshots),
+                "orphaned_bytes": orphaned_snapshot_bytes,
+                "dangling": 0,
             },
         }
         severity_rank = {"error": 0, "warning": 1, "info": 2}
@@ -1120,6 +1205,12 @@ def render_du(doc: dict) -> str:
     lines.append(
         f"  {'total':<9} {doc.get('total_objects', 0):>9} "
         f"{traceexport.fmt_bytes(doc.get('total_bytes', 0)):>10}")
+    chunk_row = planes.get("chunks") or {}
+    if chunk_row.get("snapshots"):
+        lines.append(
+            f"  (chunks plane includes {chunk_row['snapshots']} "
+            f"session-snapshot recipe(s), "
+            f"{traceexport.fmt_bytes(chunk_row.get('snapshot_bytes', 0))})")
     tenants = doc.get("tenants") or {}
     if tenants:
         lines.append("  tenants:")

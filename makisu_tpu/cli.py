@@ -337,6 +337,29 @@ def make_parser() -> argparse.ArgumentParser:
                           help="print the raw /alerts JSON payload "
                                "instead of the human render")
 
+    sessions_p = sub.add_parser(
+        "sessions", help="inspect a worker's resident build sessions, "
+                         "or checkpoint/restore them through the "
+                         "chunk-addressed snapshot plane")
+    sessions_p.add_argument("socket",
+                            help="worker unix socket to query")
+    sessions_p.add_argument("verb", nargs="?", default="list",
+                            choices=("list", "snapshot", "restore"),
+                            help="list sessions (default), snapshot "
+                                 "resident sessions to the chunk CAS, "
+                                 "or restore/stage a snapshot")
+    sessions_p.add_argument("context", nargs="?", default="",
+                            help="context dir (optional for snapshot: "
+                                 "all sessions; required for restore)")
+    sessions_p.add_argument("--from", dest="from_socket", default="",
+                            help="restore: pull the recipe from this "
+                                 "worker's socket and push it to "
+                                 "SOCKET (the fleet prewarm hand-off, "
+                                 "by hand)")
+    sessions_p.add_argument("--json", action="store_true",
+                            dest="json_out",
+                            help="print raw JSON payloads")
+
     top = sub.add_parser(
         "top", help="live terminal view of a worker's (or fleet "
                     "front door's) builds")
@@ -441,6 +464,16 @@ def make_parser() -> argparse.ArgumentParser:
                          help="slo-smoke: write the alert transitions "
                               "(fired/resolved) as an alert-only "
                               "NDJSON file — the CI artifact")
+    loadgen.add_argument("--prewarm-smoke", action="store_true",
+                         help="session-snapshot recovery scenario: a "
+                              "worker is killed (no teardown) after a "
+                              "resident warm build and a fresh worker "
+                              "over the same storage must rebuild "
+                              "warm_mode=restored, byte-identical, "
+                              "within 2x of the resident floor; then "
+                              "a 2-worker fleet drains a session "
+                              "holder and the next build must land "
+                              "on the prewarmed survivor")
 
     history = sub.add_parser(
         "history", help="render build-history trends, or `history "
@@ -797,9 +830,18 @@ def _build_once(args) -> int:
         build_session = None
         abs_context = os.path.abspath(args.context)
         if session_mod.enabled():
+            # The restore spec (storage dir + PORTABLE flag identity)
+            # lets a cold acquire consult the chunk-addressed snapshot
+            # plane: same logical build, any worker — the fleet front
+            # door rewrites --storage per worker, which is exactly why
+            # the portable identity excludes it.
             build_session, verdict = session_mod.manager().acquire(
                 abs_context, session_mod.identity_from_build_args(
-                    args, _storage_dir(args.storage), gzip_backend_id))
+                    args, _storage_dir(args.storage), gzip_backend_id),
+                restore_spec=(
+                    _storage_dir(args.storage),
+                    session_mod.portable_identity_from_build_args(
+                        args, gzip_backend_id)))
         else:
             verdict = "disabled"
         build_ok = False
@@ -811,10 +853,13 @@ def _build_once(args) -> int:
                         invocation_mode.get() == "worker"
                         or bool(getattr(args, "watch", False))))
                 session_mod.set_warm_mode(
-                    mode if verdict == "hit" else "fresh")
+                    mode if verdict in ("hit", "restored")
+                    else "fresh")
                 ledger_mod.record(
                     "session", abs_context, verdict,
-                    reason="reused" if verdict == "hit" else "created",
+                    reason=("reused" if verdict == "hit"
+                            else "restored" if verdict == "restored"
+                            else "created"),
                     mode=mode, dirty=len(ctx.dirty_paths),
                     resident_bytes=build_session.resident_bytes())
             else:
@@ -1608,6 +1653,74 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def cmd_sessions(args) -> int:
+    """Resident-session surface of one worker: ``sessions SOCKET``
+    lists the resident sessions plus the snapshot counters;
+    ``sessions SOCKET snapshot [CONTEXT]`` checkpoints resident
+    session state into the chunk-addressed snapshot plane;
+    ``sessions SOCKET restore CONTEXT [--from SRC]`` stages a
+    snapshot onto SOCKET (pulling the recipe from SRC when given —
+    the fleet prewarm hand-off, driven by hand)."""
+    import json as json_mod
+
+    from makisu_tpu.worker import WorkerClient
+    client = WorkerClient(args.socket)
+    try:
+        if args.verb == "snapshot":
+            payload = client.snapshot_sessions(args.context)
+            if args.json_out:
+                print(json_mod.dumps(payload, indent=1))
+            else:
+                print(f"checkpointed {payload.get('snapshotted', 0)} "
+                      f"session(s)")
+            return 0
+        if args.verb == "restore":
+            if not args.context:
+                raise SystemExit(
+                    "sessions restore requires a context dir")
+            if args.from_socket:
+                recipe = WorkerClient(
+                    args.from_socket).session_snapshot(args.context)
+                payload = client.restore_session({"recipe": recipe})
+            else:
+                payload = client.restore_session(
+                    {"context": args.context})
+            if args.json_out:
+                print(json_mod.dumps(payload, indent=1))
+            elif payload.get("ok"):
+                print("snapshot staged; the next build on this "
+                      "context restores warm")
+            else:
+                print("restore refused: "
+                      f"{payload.get('reason') or 'unknown'}")
+            return 0 if payload.get("ok") else 1
+        snap = client.sessions()
+    except (OSError, RuntimeError, ValueError) as e:
+        raise SystemExit(
+            f"sessions {args.verb} via {args.socket} failed: {e}")
+    if args.json_out:
+        print(json_mod.dumps(snap, indent=1))
+        return 0
+    sessions = snap.get("sessions") or []
+    print(f"{len(sessions)} resident session(s) — {args.socket}")
+    for row in sessions:
+        print(f"  {row.get('context', '?')}: builds={row.get('builds', 0)} "
+              f"bytes={row.get('resident_bytes', 0)} "
+              f"exact={str(bool(row.get('exact'))).lower()} "
+              f"busy={str(bool(row.get('busy'))).lower()}")
+    counters = snap.get("snapshot") or {}
+    if counters:
+        print("snapshot: " + " ".join(
+            f"{k}={counters[k]}" for k in
+            ("write", "write_error", "restore", "restore_refused",
+             "restore_error") if k in counters))
+        failure = counters.get("last_restore_failure") or {}
+        if failure.get("reason"):
+            print(f"  last restore failure: {failure.get('context', '?')} "
+                  f"({failure['reason']})")
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live terminal view of a worker: in-flight builds (tenant,
     phase, progress age, queue wait, cache hit rate), the admission
@@ -1693,7 +1806,7 @@ def main(argv: list[str] | None = None) -> int:
                 "fleet": cmd_fleet, "report": cmd_report,
                 "doctor": cmd_doctor, "explain": cmd_explain,
                 "check": cmd_check, "top": cmd_top,
-                "alerts": cmd_alerts,
+                "alerts": cmd_alerts, "sessions": cmd_sessions,
                 "loadgen": cmd_loadgen, "history": cmd_history,
                 "du": cmd_du}
     handler = handlers.get(args.command)

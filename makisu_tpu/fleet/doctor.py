@@ -195,6 +195,33 @@ def diagnose_fleet(health: dict,
                           f"entries seeded) — eviction dry-runs "
                           f"refuse until the seed completes",
             })
+    # 5b. Session-snapshot restore failures: each worker's fleet row
+    # carries the snapshot-plane digest captured from its /sessions
+    # poll (write/restore tallies + the last restore failure). A
+    # worker refusing restores is paying cold rebuilds the snapshot
+    # plane exists to avoid — the reason names why (stale, isa_change,
+    # flag_identity, chunks_unavailable, corrupt, ...).
+    for w in alive:
+        wid = w.get("id", "?")
+        snap = w.get("session_snapshot") or {}
+        failed = (int(snap.get("restore_refused", 0) or 0)
+                  + int(snap.get("restore_error", 0) or 0))
+        if not failed:
+            continue
+        last = snap.get("last_restore_failure") or {}
+        reason = str(last.get("reason", "") or "unknown")
+        context = str(last.get("context", "") or "")
+        findings.append({
+            "severity": "warning",
+            "kind": "snapshot_restore_failed",
+            "worker": wid,
+            "detail": f"worker {wid} failed {failed} session-"
+                      f"snapshot restore(s) (last: {reason}"
+                      + (f" on {context}" if context else "")
+                      + f"; {int(snap.get('restore', 0) or 0)} "
+                      f"succeeded) — its builds rebuild cold "
+                      f"instead of restoring warm",
+        })
     # 6. Placement-memo drift: the sticky memo says a context lives
     # on worker X, but no alive worker — or a DIFFERENT one — reports
     # the resident session. Routing still works (the memo re-places),

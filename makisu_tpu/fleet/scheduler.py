@@ -155,6 +155,10 @@ class WorkerState:
         self.max_concurrent = 0
         self.sessions: set[str] = set()
         self.session_hits = 0
+        # Session-snapshot digest from /sessions (write/restore
+        # tallies + last restore failure) — what `doctor --fleet`'s
+        # snapshot_restore_failed finding reads from the fleet row.
+        self.session_snapshot: dict = {}
         # Distribution-plane digest from /healthz: what the worker can
         # serve (recipes/packs its builds published) and how much it
         # has served — the peer plane's capacity signal per worker.
@@ -203,6 +207,7 @@ class WorkerState:
             "max_concurrent_builds": self.max_concurrent,
             "sessions": sorted(self.sessions),
             "session_hits": self.session_hits,
+            "session_snapshot": dict(self.session_snapshot),
             "serve": dict(self.serve),
             "storage": dict(self.storage),
             "builds_succeeded": self.builds_succeeded,
@@ -347,6 +352,8 @@ class FleetScheduler:
                     row.get("context", "")
                     for row in sessions.get("sessions", [])}
                 state.session_hits = int(sessions.get("hits", 0))
+                state.session_snapshot = dict(
+                    sessions.get("snapshot") or {})
                 state.serve = dict(health.get("serve") or {})
                 state.storage = dict(health.get("storage") or {})
                 state.alerts = dict(health.get("alerts") or {})
@@ -599,6 +606,37 @@ class FleetScheduler:
         with self._mu:
             return {wid: w.health_score
                     for wid, w in self.workers.items()}
+
+    def snapshot_sources(self, context_key: str,
+                         exclude: frozenset[str] | set[str] =
+                         frozenset()) -> list[tuple[str, str]]:
+        """``(worker_id, socket)`` candidates that may hold a session
+        snapshot for ``context_key``, best-first: workers reporting a
+        resident session, then the sticky placement memo's worker,
+        then every other ALIVE worker (draining included — a draining
+        worker's snapshot is exactly what its contexts' next host
+        wants to pull). The prewarm path walks this list."""
+        with self._mu:
+            rows = [(w, context_key in w.sessions,
+                     self._placements.get(context_key) == wid)
+                    for wid, w in self.workers.items()
+                    if w.alive and wid not in exclude]
+        rows.sort(key=lambda r: (not r[1], not r[2], r[0].spec.id))
+        return [(w.spec.id, w.spec.socket_path) for w, _, _ in rows]
+
+    def note_prewarm(self, context_key: str, worker_id: str,
+                     ok: bool, reason: str, source: str = "") -> None:
+        """Ledger one prewarm attempt (verdict ``prewarm`` /
+        ``prewarm_failed``) so routing-shift warmth is auditable from
+        the same decision surface as every route verdict."""
+        # Field name is from_worker, not source: the decision row is
+        # re-recorded on the cache ledger whose own first argument is
+        # the ledger source ("fleet").
+        self._record_decision(
+            context_key or "<no-context>",
+            "prewarm" if ok else "prewarm_failed",
+            reason=reason, tenant="", worker=worker_id,
+            from_worker=source)
 
     def note_build_done(self, worker_id: str) -> None:
         """A forwarded build finished (success or failure — outcome

@@ -147,8 +147,17 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if not self.server.scheduler.drain(worker_id, draining):
             self._respond(404, b"unknown worker")
             return
+        snapshotted = 0
+        if draining:
+            # Checkpoint the draining worker's resident sessions into
+            # the snapshot plane NOW: its contexts re-route on the next
+            # build, and the prewarm path needs recipes to pull. Best-
+            # effort — a worker that can't answer is already the case
+            # drain exists for.
+            snapshotted = self.server.checkpoint_worker(worker_id)
         self._respond(200, json.dumps(
-            {"worker": worker_id, "draining": draining}).encode(),
+            {"worker": worker_id, "draining": draining,
+             "sessions_snapshotted": snapshotted}).encode(),
             content_type="application/json")
 
     def _handle_build(self) -> None:
@@ -440,6 +449,18 @@ class FleetServer(socketserver.ThreadingMixIn,
                     if worker.spec.storage:
                         forward_argv = rewrite_storage(
                             argv, worker.spec.storage)
+                    # Prewarm: a context-keyed build routed AWAY from
+                    # its session holder (placement change, drain,
+                    # health demotion, failover) pushes the session
+                    # snapshot's chunk plan at the target over the
+                    # peer wire first, so the build lands on a warm
+                    # restore instead of a cold rebuild. Best-effort
+                    # and bounded; affinity routes skip it — the
+                    # session is already there.
+                    if context_key and verdict != "affinity":
+                        with metrics.span("fleet_prewarm",
+                                          worker=worker.spec.id):
+                            self._prewarm(context_key, worker)
                     # No-wait admission only when a refusal still has
                     # somewhere ELIGIBLE to go (dead/draining workers
                     # are not alternatives), never for an affinity
@@ -513,6 +534,68 @@ class FleetServer(socketserver.ThreadingMixIn,
             events.emit("build_end", trace_id=registry.trace_id,
                         exit_code=exit_code)
             metrics.reset_build_registry(reg_token)
+
+    def _prewarm(self, context_key: str, worker) -> bool:
+        """Best-effort session-snapshot push: pull the context's
+        recipe from the best source worker (session holders first),
+        POST it at the routed-to target, let the target fetch the
+        chunks over the existing peer wire. Every failure is swallowed
+        — the build proceeds cold, exactly as before prewarm existed —
+        but the attempt lands in the decision ledger either way."""
+        from makisu_tpu.worker.client import WorkerClient
+        scheduler = self.scheduler
+        target_id = worker.spec.id
+        recipe = None
+        source_id = ""
+        for wid, socket_path in scheduler.snapshot_sources(
+                context_key, exclude={target_id}):
+            client = WorkerClient(socket_path, connect_timeout=2.0,
+                                  control_timeout=10.0, retries=0)
+            try:
+                recipe = client.session_snapshot(context_key)
+                source_id = wid
+                break
+            except (OSError, RuntimeError, ValueError):
+                continue
+        if recipe is None:
+            # Nothing to push: no snapshot exists anywhere (a cold
+            # context) — not a failure worth ledger noise.
+            return False
+        target = WorkerClient(worker.spec.socket_path,
+                              connect_timeout=2.0,
+                              control_timeout=30.0, retries=0)
+        payload: dict = {"recipe": recipe}
+        if worker.spec.storage:
+            payload["storage"] = worker.spec.storage
+        try:
+            result = target.restore_session(payload)
+            ok = bool(result.get("ok"))
+            reason = str(result.get("reason", ""))
+        except (OSError, RuntimeError, ValueError) as e:
+            ok, reason = False, f"push_failed:{type(e).__name__}"
+        scheduler.note_prewarm(context_key, target_id, ok,
+                               reason or "staged", source=source_id)
+        return ok
+
+    def checkpoint_worker(self, worker_id: str) -> int:
+        """POST /sessions/snapshot at one worker (the drain hand-off);
+        returns the number of sessions checkpointed (0 on any
+        failure)."""
+        from makisu_tpu.worker.client import WorkerClient
+        with self.scheduler._mu:
+            state = self.scheduler.workers.get(worker_id)
+            socket_path = state.spec.socket_path if state else ""
+        if not socket_path:
+            return 0
+        client = WorkerClient(socket_path, connect_timeout=2.0,
+                              control_timeout=30.0, retries=0)
+        try:
+            return int(client.snapshot_sessions().get(
+                "snapshotted", 0))
+        except (OSError, RuntimeError, ValueError) as e:
+            log.warning("fleet: drain checkpoint of %s failed: %s",
+                        worker_id, e)
+            return 0
 
     def _forward(self, worker, argv: list[str], tenant: str, emit,
                  no_wait: bool, terminal_extra: dict,

@@ -191,6 +191,8 @@ class _Sampler(threading.Thread):
 
 
 def run(args) -> int:
+    if getattr(args, "prewarm_smoke", False):
+        return _run_prewarm_smoke(args)
     if getattr(args, "slo_smoke", False):
         return _run_slo_smoke(args)
     if getattr(args, "fleet", False):
@@ -1138,6 +1140,319 @@ def render_slo_smoke(report: dict) -> str:
         lines.append(f"  alert events: {ev.get('fired', 0)} fired, "
                      f"{ev.get('resolved', 0)} resolved → "
                      f"{ev.get('path', '')}")
+    return "\n".join(lines) + "\n"
+
+
+# -- session-snapshot prewarm / kill -9 recovery smoke ----------------------
+
+
+def _run_prewarm_smoke(args) -> int:
+    """The session-snapshot plane's acceptance scenario, end to end on
+    real surfaces (no test-only hooks):
+
+    KILL LEG — one worker builds a context twice; the second build is
+    the RESIDENT warm floor. The worker then dies the ``kill -9`` way:
+    its listener stops and every in-memory session dies with it — no
+    invalidation, no extra flush. The only durable warm state is the
+    chunk-addressed snapshot ``finish_build`` checkpointed. A fresh
+    worker over the same storage rebuilds the UNCHANGED context and
+    must report ``warm_mode=restored``, reproduce the warm build's
+    layer digests byte for byte, count a restore on ``/sessions``, and
+    land within 2x of the resident floor (plus a 1s absolute allowance
+    so a sub-second floor doesn't turn scheduler jitter into a flake).
+
+    DRAIN LEG — a 2-worker fleet: after two builds pin a session
+    holder, the holder is gracefully drained. The front door must
+    checkpoint its sessions (``sessions_snapshotted`` in the drain
+    response), and the next build must route to the OTHER worker with
+    a ``prewarm`` verdict on the route-decision ledger — the target
+    restored from the pushed snapshot before the build arrived — then
+    report ``warm_mode=restored`` with digests identical to the
+    holder's.
+
+    Exit code is nonzero when any gate fails."""
+    from makisu_tpu.fleet import FleetServer, WorkerSpec
+    from makisu_tpu.fleet import peers as fleet_peers
+    from makisu_tpu.utils import history as history_mod
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+    from makisu_tpu.worker.client import _UnixHTTPConnection
+
+    work_dir = args.work_dir or tempfile.mkdtemp(
+        prefix="makisu-prewarm-smoke-")
+    os.makedirs(work_dir, exist_ok=True)
+    cleanup_work = not args.work_dir
+
+    gates: dict[str, bool] = {}
+    prewarm: dict = {}
+    servers: list[WorkerServer] = []
+    fleet_server = None
+
+    def spawn(wid: str) -> WorkerServer:
+        sock = os.path.join(work_dir, f"{wid}.sock")
+        server = WorkerServer(
+            sock, max_concurrent_builds=args.max_concurrent_builds)
+        server.serve_background()
+        servers.append(server)
+        return server
+
+    def wait_ready(socket_path: str) -> bool:
+        client = WorkerClient(socket_path)
+        deadline = time.monotonic() + args.ready_timeout
+        while not client.ready():
+            if time.monotonic() >= deadline:
+                log.error("prewarm-smoke: %s never became ready",
+                          socket_path)
+                return False
+            time.sleep(0.05)
+        return True
+
+    def build(socket_path: str, ctx: str, tag: str, root: str,
+              storage: str, history: str):
+        """One build; ``storage`` empty routes through a front door
+        (which rewrites --storage per worker). Returns (exit code,
+        wall seconds, terminal build record)."""
+        client = WorkerClient(socket_path)
+        argv = ["--log-level", "error", "--history-out", history,
+                "build", ctx, "-t", tag, "--hasher", args.hasher,
+                "--root", root]
+        if storage:
+            argv += ["--storage", storage]
+        t0 = time.monotonic()
+        reg_token = metrics.set_build_registry(
+            metrics.MetricsRegistry())
+        try:
+            code = client.build(argv, tenant="default")
+        except (OSError, RuntimeError,
+                http.client.HTTPException) as e:
+            code = -1
+            log.error("prewarm-smoke build %s failed to submit: %s",
+                      tag, e)
+        finally:
+            metrics.reset_build_registry(reg_token)
+        return code, time.monotonic() - t0, client.last_build or {}
+
+    def last_warm_mode(history: str) -> str:
+        records = history_mod.read_history(history)
+        return str(records[-1].get("warm_mode", "")) \
+            if records else ""
+
+    def digests_of(storage: str, tag: str) -> list[str]:
+        try:
+            return _layer_digests(storage, tag)
+        except (OSError, KeyError) as e:
+            log.warning("prewarm-smoke: could not read digests for "
+                        "%s: %s", tag, e)
+            return []
+
+    try:
+        # ---- kill leg -------------------------------------------------
+        storage = os.path.join(work_dir, "kill-storage")
+        ctx = os.path.join(work_dir, "kill-ctx")
+        _make_template(ctx, 0, args.files, args.file_kb)
+        root = os.path.join(work_dir, "kill-root")
+        os.makedirs(root, exist_ok=True)
+        hist = os.path.join(work_dir, "kill-history.jsonl")
+        w0 = spawn("kill-w0")
+        if not wait_ready(w0.socket_path):
+            return 1
+        code0, cold_s, _ = build(w0.socket_path, ctx,
+                                 "prewarm/kill:cold", root, storage,
+                                 hist)
+        code1, floor_s, _ = build(w0.socket_path, ctx,
+                                  "prewarm/kill:warm", root, storage,
+                                  hist)
+        floor_mode = last_warm_mode(hist)
+        warm_digests = digests_of(storage, "prewarm/kill:warm") \
+            if code1 == 0 else []
+        # The kill: stop the listener and DROP the process state.
+        # Nothing is invalidated and nothing flushes beyond what
+        # finish_build already checkpointed — the disk is exactly what
+        # a SIGKILLed worker leaves behind.
+        w0.shutdown()
+        w0.server_close()
+        servers.remove(w0)
+        try:
+            os.unlink(w0.socket_path)
+        except OSError:
+            pass
+
+        w1 = spawn("kill-w1")
+        if not wait_ready(w1.socket_path):
+            return 1
+        code2, restored_s, _ = build(w1.socket_path, ctx,
+                                     "prewarm/kill:restored", root,
+                                     storage, hist)
+        restored_mode = last_warm_mode(hist)
+        restored_digests = digests_of(storage,
+                                      "prewarm/kill:restored") \
+            if code2 == 0 else []
+        try:
+            snap_stats = (json.loads(_front_get(
+                w1.socket_path, "/sessions")).get("snapshot") or {})
+        except (OSError, ValueError):
+            snap_stats = {}
+        budget_s = max(2.0 * floor_s, floor_s + 1.0)
+        gates["kill_builds_succeeded"] = \
+            code0 == 0 and code1 == 0 and code2 == 0
+        gates["kill_floor_resident"] = floor_mode == "resident"
+        gates["kill_warm_mode_restored"] = restored_mode == "restored"
+        gates["kill_digest_identity"] = bool(warm_digests) \
+            and restored_digests == warm_digests
+        gates["kill_restore_counted"] = \
+            int(snap_stats.get("restore", 0)) >= 1
+        gates["kill_within_2x_floor"] = \
+            code2 == 0 and restored_s <= budget_s
+        prewarm["kill"] = {
+            "cold_seconds": round(cold_s, 3),
+            "floor_seconds": round(floor_s, 3),
+            "restored_seconds": round(restored_s, 3),
+            "budget_seconds": round(budget_s, 3),
+            "floor_mode": floor_mode,
+            "restored_mode": restored_mode,
+            "layers": len(warm_digests),
+            "snapshot_counts": snap_stats,
+        }
+        w1.shutdown()
+        w1.server_close()
+        servers.remove(w1)
+        fleet_peers.reset()
+
+        # ---- drain leg ------------------------------------------------
+        specs = []
+        for i in range(2):
+            wid = f"drain-w{i}"
+            server = spawn(wid)
+            specs.append(WorkerSpec(
+                wid, server.socket_path,
+                os.path.join(work_dir, f"{wid}-storage")))
+        for spec in specs:
+            if not wait_ready(spec.socket_path):
+                return 1
+        fleet_server = FleetServer(
+            os.path.join(work_dir, "fleet.sock"), specs,
+            poll_interval=0.25)
+        fleet_server.serve_background()
+        if not wait_ready(fleet_server.socket_path):
+            return 1
+        storage_for = {spec.id: spec.storage for spec in specs}
+        dctx = os.path.join(work_dir, "drain-ctx")
+        _make_template(dctx, 1, args.files, args.file_kb)
+        droot = os.path.join(work_dir, "drain-root")
+        os.makedirs(droot, exist_ok=True)
+        dhist = os.path.join(work_dir, "drain-history.jsonl")
+        dcode0, _, _ = build(fleet_server.socket_path, dctx,
+                             "prewarm/drain:b0", droot, "", dhist)
+        dcode1, _, term1 = build(fleet_server.socket_path, dctx,
+                                 "prewarm/drain:b1", droot, "", dhist)
+        holder = str(term1.get("worker", ""))
+        holder_digests = digests_of(storage_for.get(holder, ""),
+                                    "prewarm/drain:b1") \
+            if dcode1 == 0 and holder in storage_for else []
+        drain_resp: dict = {}
+        conn = _UnixHTTPConnection(fleet_server.socket_path, 30.0)
+        try:
+            conn.request(
+                "POST", "/drain",
+                body=json.dumps({"worker": holder}).encode(),
+                headers={"Content-Type": "application/json"})
+            drain_resp = json.loads(
+                conn.getresponse().read() or b"{}")
+        except (OSError, ValueError) as e:
+            log.error("prewarm-smoke drain failed: %s", e)
+        finally:
+            conn.close()
+        dcode2, _, term2 = build(fleet_server.socket_path, dctx,
+                                 "prewarm/drain:b2", droot, "", dhist)
+        target = str(term2.get("worker", ""))
+        drain_mode = last_warm_mode(dhist)
+        target_digests = digests_of(storage_for.get(target, ""),
+                                    "prewarm/drain:b2") \
+            if dcode2 == 0 and target in storage_for else []
+        try:
+            fleet_stats = json.loads(_front_get(
+                fleet_server.socket_path, "/fleet"))
+        except (OSError, ValueError):
+            fleet_stats = {}
+        prewarms = [d for d in fleet_stats.get(
+            "recent_decisions", [])
+            if d.get("verdict") == "prewarm"
+            and d.get("worker") == target]
+        gates["drain_builds_succeeded"] = \
+            dcode0 == 0 and dcode1 == 0 and dcode2 == 0
+        gates["drain_sessions_snapshotted"] = \
+            int(drain_resp.get("sessions_snapshotted", 0)) >= 1
+        gates["drain_routed_off_holder"] = \
+            bool(target) and target != holder
+        gates["drain_prewarm_recorded"] = bool(prewarms)
+        gates["drain_warm_mode_restored"] = drain_mode == "restored"
+        gates["drain_digest_identity"] = bool(holder_digests) \
+            and target_digests == holder_digests
+        prewarm["drain"] = {
+            "holder": holder,
+            "target": target,
+            "sessions_snapshotted": int(
+                drain_resp.get("sessions_snapshotted", 0)),
+            "prewarm_decisions": len(prewarms),
+            "mode": drain_mode,
+            "route_totals": fleet_stats.get("route_totals", {}),
+        }
+    finally:
+        if fleet_server is not None:
+            fleet_server.shutdown()
+            fleet_server.server_close()
+        for server in servers:
+            server.shutdown()
+            server.server_close()
+        fleet_peers.reset()
+
+    prewarm["gates"] = gates
+    report = {
+        "schema": LOADGEN_SCHEMA,
+        "mode": "prewarm-smoke",
+        "config": {
+            "files": args.files,
+            "file_kb": args.file_kb,
+            "hasher": args.hasher,
+        },
+        "prewarm": prewarm,
+        "ok": bool(gates) and all(gates.values()),
+    }
+    if args.report:
+        metrics.write_json_atomic(args.report, report)
+        log.info("prewarm-smoke report written to %s", args.report)
+    print(render_prewarm_smoke(report), end="")
+    if cleanup_work:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
+def render_prewarm_smoke(report: dict) -> str:
+    """Human digest of a prewarm smoke run: one line per gate, then
+    the recovery timings and the drain hand-off the gates measured."""
+    pw = report.get("prewarm", {})
+    gates = pw.get("gates", {})
+    lines = [
+        f"prewarm-smoke: {'PASS' if report.get('ok') else 'FAIL'} "
+        f"({sum(1 for v in gates.values() if v)}/{len(gates)} gates)",
+    ]
+    for name, passed in sorted(gates.items()):
+        lines.append(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    kill = pw.get("kill") or {}
+    if kill:
+        lines.append(
+            f"  kill -9 recovery: cold {kill.get('cold_seconds', 0):.3f}s, "
+            f"resident floor {kill.get('floor_seconds', 0):.3f}s, "
+            f"restored rebuild {kill.get('restored_seconds', 0):.3f}s "
+            f"(budget {kill.get('budget_seconds', 0):.3f}s, "
+            f"mode {kill.get('restored_mode', '?')})")
+    drain = pw.get("drain") or {}
+    if drain:
+        lines.append(
+            f"  drain hand-off: {drain.get('holder', '?')} → "
+            f"{drain.get('target', '?')}  "
+            f"snapshotted {drain.get('sessions_snapshotted', 0)}, "
+            f"prewarm decisions {drain.get('prewarm_decisions', 0)}, "
+            f"mode {drain.get('mode', '?')}")
     return "\n".join(lines) + "\n"
 
 

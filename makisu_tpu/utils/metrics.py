@@ -146,6 +146,16 @@ SESSION_HITS = "makisu_session_hits"
 SESSION_INVALIDATIONS = "makisu_session_invalidations_total"
 SESSION_RESIDENT_BYTES = "makisu_session_resident_bytes"
 
+# Chunk-addressed session snapshots (worker/snapshots.py): checkpoint
+# writes (result=ok|error), chunk bytes pushed into the CAS split by
+# result=written|reused (the O(changed) incremental-write economics),
+# and restore attempts labeled result=ok|refused|error — refusals
+# carry the invalidation reason (flag_identity|isa_change|stale|...)
+# so a fleet that silently falls back to cold rebuilds still pages.
+SESSION_SNAPSHOT_WRITES = "makisu_session_snapshot_writes_total"
+SESSION_SNAPSHOT_CHUNK_BYTES = "makisu_session_snapshot_chunk_bytes_total"
+SESSION_SNAPSHOT_RESTORES = "makisu_session_snapshot_restores_total"
+
 # Fleet-wide trace stitching: inbound traceparent adoption outcomes
 # (result=adopted|malformed — a malformed header mints fresh ids and
 # is COUNTED, never crashed on), and the front door's aggregated

@@ -77,6 +77,10 @@ class ContentIDCache:
         # durability only — the live dict is the source of truth).
         self.defer_save = False
         self._saver: threading.Thread | None = None
+        # Keys written since the last drain_mutations(): the session
+        # snapshot writer's dirty-shard signal (worker/snapshots.py) —
+        # an idle checkpoint must not re-serialize 100k clean entries.
+        self._mutated: set[str] = set()
 
     def _load_locked(self) -> dict[str, list]:
         if self._entries is None:
@@ -144,7 +148,56 @@ class ContentIDCache:
             self._load_locked()[self._ns + rel] = [
                 self._key(st), int(crc), time.time_ns()]
             self._touched.add(self._ns + rel)
+            self._mutated.add(self._ns + rel)
             self._dirty = True
+
+    # -- session-snapshot surfaces (worker/snapshots.py) --
+
+    def namespace_items(self) -> dict[str, list]:
+        """Snapshot copy of this namespace's entries, keyed by REL path
+        (the namespace prefix stripped — it is the context dir, which
+        the snapshot recipe already carries)."""
+        with self._lock:
+            entries = self._load_locked()
+            n = len(self._ns)
+            return {k[n:]: list(v) for k, v in entries.items()
+                    if k.startswith(self._ns)}
+
+    def drain_mutations(self) -> set[str]:
+        """Rel paths in this namespace written since the last drain
+        (plus any foreign-namespace noise dropped silently)."""
+        with self._lock:
+            mutated = self._mutated
+            self._mutated = set()
+            n = len(self._ns)
+            return {k[n:] for k in mutated if k.startswith(self._ns)}
+
+    def merge_entries(self, entries: dict[str, list]) -> int:
+        """Adopt restored snapshot entries (rel path → entry) that do
+        not collide with fresher local knowledge. Entries keep their
+        original ``hashed_at`` timestamps, so the racily-clean guard
+        and the per-lookup stat comparison apply to them unchanged — a
+        restored entry whose file moved since the snapshot reads
+        ``stat_changed`` and re-hashes, never replays. Returns the
+        number of entries adopted."""
+        if not isinstance(entries, dict):
+            return 0
+        adopted = 0
+        with self._lock:
+            live = self._load_locked()
+            for rel, entry in entries.items():
+                if not (isinstance(rel, str) and isinstance(entry, list)
+                        and len(entry) == 3
+                        and isinstance(entry[0], list)):
+                    continue
+                key = self._ns + rel
+                if key in live:
+                    continue  # local knowledge is newer by definition
+                live[key] = list(entry)
+                adopted += 1
+            if adopted:
+                self._dirty = True
+        return adopted
 
     def begin_build(self) -> None:
         """Reset the per-build touched set (a resident session reuses

@@ -422,6 +422,57 @@ class WorkerClient:
         finally:
             conn.close()
 
+    def session_snapshot(self, context: str) -> dict:
+        """Fetch the named context's session-snapshot recipe
+        (``GET /sessions/snapshot?context=``) — the chunk-plan
+        document the fleet prewarm path pushes at a target worker.
+        404 (no snapshot on disk) raises like every other non-200."""
+        from urllib.parse import quote
+        conn, resp = self._control(
+            f"/sessions/snapshot?context={quote(context, safe='')}")
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /sessions/snapshot returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def snapshot_sessions(self, context: str = "") -> dict:
+        """Checkpoint resident session state into the chunk-addressed
+        snapshot plane (``POST /sessions/snapshot``): the named
+        context's session, or every idle session when ``context`` is
+        empty. Returns ``{"snapshotted": N}``."""
+        body = json.dumps({"context": context}).encode()
+        conn, resp = self._request("POST", "/sessions/snapshot", body,
+                                   timeout=self.control_timeout)
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /sessions/snapshot returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def restore_session(self, payload: dict) -> dict:
+        """Stage a session snapshot onto this worker
+        (``POST /sessions/restore``) so the NEXT build on the context
+        restores warm. ``payload`` is ``{"recipe": {...}}`` (prewarm
+        push: chunks are fetched over the peer wire before the recipe
+        lands) or ``{"context": dir}`` (re-validate a recipe already
+        on this worker's storage). Returns ``{"ok": bool, "reason"}``;
+        refusals are data, not HTTP errors."""
+        body = json.dumps(payload).encode()
+        conn, resp = self._request("POST", "/sessions/restore", body,
+                                   timeout=self.control_timeout)
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /sessions/restore returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
     def builds(self) -> WorkerBuilds:
         """The worker's ``GET /builds`` payload: in-flight + recently
         finished builds (tenant, phase, queue wait, progress age,

@@ -334,6 +334,25 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 json.dumps(self.server.session_mgr.stats()).encode(),
                 content_type="application/json")
+        elif self.path.startswith("/sessions/snapshot"):
+            # Session-snapshot recipe for one context: the chunk-plan
+            # document the fleet prewarm path pulls from a source
+            # worker and pushes at the routed-to target. Recipes live
+            # on this worker's registered storage dirs; the chunks
+            # they name are served by the /chunks endpoint above —
+            # the snapshot plane rides the existing peer wire.
+            from urllib.parse import parse_qs, urlsplit
+            query = parse_qs(urlsplit(self.path).query)
+            context = (query.get("context") or [""])[0]
+            if not context:
+                self._respond(400, b"context query param required")
+                return
+            recipe = self.server.find_session_snapshot(context)
+            if recipe is None:
+                self._respond(404, b"no snapshot for context")
+                return
+            self._respond(200, json.dumps(recipe).encode(),
+                          content_type="application/json")
         elif self.path.startswith("/chunks/"):
             # Peer chunk exchange, serving side: read-only chunk bytes
             # out of the local chunk CAS(es). Strictly local — a miss
@@ -494,6 +513,48 @@ class _Handler(BaseHTTPRequestHandler):
             dropped = self.server.session_mgr.invalidate(context)
             self._respond(200, json.dumps(
                 {"invalidated": dropped}).encode(),
+                content_type="application/json")
+            return
+        if self.path == "/sessions/snapshot":
+            # Checkpoint resident sessions into the chunk-addressed
+            # snapshot plane NOW: body ``{"context": PATH}`` snapshots
+            # that context's session, ``{}`` every idle session. The
+            # drain path calls this so a worker leaving the fleet
+            # leaves its warmth behind in the CAS.
+            length = int(self.headers.get("Content-Length", "0"))
+            context = ""
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                    context = str((body or {}).get("context", ""))
+                except (ValueError, AttributeError):
+                    self._respond(400, b"bad json body")
+                    return
+            count = self.server.session_mgr.snapshot_all(context)
+            self._respond(200, json.dumps(
+                {"snapshotted": count}).encode(),
+                content_type="application/json")
+            return
+        if self.path == "/sessions/restore":
+            # Stage a session snapshot on THIS worker so the next
+            # build on the context restores warm: ``{"recipe": {...}}``
+            # (the prewarm push — chunks fetched over the peer wire
+            # before the recipe lands, an optional ``"storage"`` names
+            # the target storage dir) or ``{"context": PATH}`` (re-
+            # validate a recipe already on this worker's storage).
+            # Refusals are data (``{"ok": false, "reason"}``), not
+            # HTTP errors: prewarm is best-effort by design.
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length)) or {}
+                if not isinstance(body, dict):
+                    raise ValueError("body must be an object")
+            except (ValueError, AttributeError):
+                self._respond(400, b"bad json body")
+                return
+            ok, reason = self.server.stage_session_snapshot(body)
+            self._respond(200, json.dumps(
+                {"ok": ok, "reason": reason}).encode(),
                 content_type="application/json")
             return
         if self.path != "/build":
@@ -896,6 +957,74 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
     def storage_dirs(self) -> list[str]:
         with self._storage_mu:
             return sorted(self._storage_dirs)
+
+    # -- session-snapshot plane (worker/snapshots.py) ----------------------
+
+    def find_session_snapshot(self, context: str) -> dict | None:
+        """The newest session-snapshot recipe for ``context`` across
+        this worker's registered storage dirs (GET /sessions/snapshot
+        — the fleet prewarm pull). Resident sessions name their own
+        storage dir, so that one is probed first."""
+        from makisu_tpu.worker import snapshots as snapshots_mod
+        dirs: list[str] = []
+        session_dir = self.session_mgr.storage_dir_for(context)
+        if session_dir:
+            dirs.append(session_dir)
+        dirs.extend(d for d in self.storage_dirs() if d not in dirs)
+        for storage_dir in dirs:
+            try:
+                recipe = snapshots_mod.SnapshotStore(
+                    storage_dir).load_for_context(context)
+            except OSError:
+                continue
+            if recipe is not None:
+                return recipe
+        return None
+
+    def stage_session_snapshot(self, body: dict) -> tuple[bool, str]:
+        """POST /sessions/restore: land a snapshot recipe (and its
+        chunks, over the peer wire if needed) on this worker's storage
+        so the next build's ``SessionManager.acquire`` restores warm.
+        Failures count into the manager's snapshot ledger — that is
+        what ``doctor --fleet``'s snapshot_restore_failed finding
+        reads."""
+        from makisu_tpu.worker import snapshots as snapshots_mod
+        recipe = body.get("recipe")
+        context = str(body.get("context", ""))
+        storage = str(body.get("storage", ""))
+        if recipe is None and context:
+            # Re-validate a recipe already on local storage.
+            recipe = self.find_session_snapshot(context)
+            if recipe is None:
+                return False, "no_snapshot"
+        if not isinstance(recipe, dict):
+            return False, "no_recipe"
+        context = str(recipe.get("context", "")) or context
+        if not storage:
+            dirs = self.storage_dirs()
+            if len(dirs) == 1:
+                storage = dirs[0]
+            elif not dirs:
+                return False, "no_storage"
+            else:
+                # Ambiguous: prefer the storage a resident session (or
+                # a prior snapshot of this context) already uses.
+                storage = self.session_mgr.storage_dir_for(context) \
+                    or dirs[0]
+        try:
+            ok, reason = snapshots_mod.SnapshotStore(storage).stage(
+                recipe)
+        except Exception as e:  # noqa: BLE001 - control plane answers
+            ok, reason = False, f"error:{type(e).__name__}"
+        if ok:
+            # Staged chunks are servable onward (a prewarmed worker is
+            # a peer source for the NEXT prewarm hop).
+            self.add_served_chunk_root(storage)
+        else:
+            self.session_mgr.note_snapshot("restore_refused",
+                                           context=context,
+                                           reason=reason)
+        return ok, reason
 
     def _census_for(self, storage_dir: str,
                     max_age: float | None = None) -> dict:
@@ -1409,6 +1538,10 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                     ("count", "resident_bytes", "hits",
                      "invalidations", "max_sessions",
                      "max_resident_bytes")}
+        # Snapshot-plane digest rides along: write/restore tallies and
+        # the last restore failure — what the fleet poll captures and
+        # `doctor --fleet`'s snapshot_restore_failed finding reads.
+        sessions["snapshot"] = session_stats.get("snapshot", {})
         # Distribution-plane vitals: what this worker can serve
         # (recipes/packs published by its builds) — the capacity
         # signal the fleet scheduler surfaces per worker. Scoped to

@@ -140,16 +140,10 @@ def _isa_identity() -> str:
     return native.isa_route_if_resolved() or "unresolved"
 
 
-def identity_from_build_args(args, storage_dir: str,
-                             gzip_backend_id: str) -> str:
-    """Stable digest of the resolved flags that shape build identity
-    for one context. Anything here that moves mints a new session
-    (reason=flag_identity) — mixing, say, two hashers' warm state
-    would be silently wrong."""
-    ident = {
+def _identity_dict(args, gzip_backend_id: str) -> dict:
+    return {
         "context": os.path.abspath(args.context),
         "root": os.path.abspath(args.root),
-        "storage": os.path.abspath(storage_dir),
         "dockerfile": os.path.abspath(
             args.file or os.path.join(args.context, "Dockerfile")),
         "hasher": args.hasher,
@@ -160,9 +154,44 @@ def identity_from_build_args(args, storage_dir: str,
         "build_args": sorted(args.build_arg),
         "blacklist": sorted(args.blacklist),
     }
+
+
+def _digest_identity(ident: dict) -> str:
     blob = json.dumps(ident, sort_keys=True,
                       separators=(",", ":")).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def identity_from_build_args(args, storage_dir: str,
+                             gzip_backend_id: str) -> str:
+    """Stable digest of the resolved flags that shape build identity
+    for one context. Anything here that moves mints a new session
+    (reason=flag_identity) — mixing, say, two hashers' warm state
+    would be silently wrong."""
+    ident = _identity_dict(args, gzip_backend_id)
+    ident["storage"] = os.path.abspath(storage_dir)
+    return _digest_identity(ident)
+
+
+def portable_identity_from_build_args(args,
+                                      gzip_backend_id: str) -> str:
+    """The flag identity MINUS the storage dir: the fleet front door
+    rewrites ``--storage`` per worker, so the full identity of one
+    logical build differs across workers. Session snapshots key and
+    validate on this portable form — everything that shapes build
+    OUTPUT is still in it, only the machine-local storage location is
+    not (a restored memo never depends on where chunks happen to
+    live)."""
+    return _digest_identity(_identity_dict(args, gzip_backend_id))
+
+
+def snapshot_policy() -> str:
+    """MAKISU_TPU_SESSION_SNAPSHOT: "1" checkpoints every successful
+    build, "0" disables the snapshot plane entirely, default "auto"
+    checkpoints only residency-hinted sessions (worker / --watch /
+    repeat builds) — a one-shot CLI build on a cold host skips the
+    serialization it could never redeem."""
+    return os.environ.get("MAKISU_TPU_SESSION_SNAPSHOT", "auto")
 
 
 # -- inotify watcher --------------------------------------------------------
@@ -374,6 +403,35 @@ class BuildSession:
         # Whether arming expensive tracking (the full-walk baseline)
         # is worth it: set per build from resident_process / repeat use.
         self._resident_hint = False
+        # -- session-snapshot plane (worker/snapshots.py) --
+        # The portable flag identity + storage dir arrive with the
+        # lease; without them the snapshot plane stays dark.
+        self.portable_identity: str | None = None
+        self.storage_dir: str | None = None
+        # True for the first build after a snapshot restore: reported
+        # as warm_mode=restored. The companion flag below survives
+        # until the first release(), where a byte-budget eviction the
+        # restore caused labels lru_restore instead of plain lru.
+        self.restored = False
+        self._restore_fresh = False
+        # Restored stat-cache entries, merged into the context's
+        # content-ID cache at the next begin_build (the cache instance
+        # doesn't exist until a build arrives).
+        self._restored_stat_entries: dict | None = None
+        # A restored walk baseline certifies a PAST point; the next
+        # poll must delta against it once before trusting the watcher.
+        self._gap_delta_pending = False
+        # Incremental-write bookkeeping: previous checkpoint's shard
+        # chunks (carry-forward), dirty flags per shard family, and the
+        # watcher-mode persistence baseline (the live watcher session
+        # needs no walk; snapshots do).
+        self._snap_shards: dict[str, dict] = {}
+        self._snap_scan_dirty = True
+        self._snap_stat_all = True
+        self._snap_walk_dirty: set[str] = set()
+        self._snap_walk_all = True
+        self._snap_baseline: walk_mod.TreeSnapshot | None = None
+        self._snap_gap_paths = 0
 
     # -- accounting --
 
@@ -428,16 +486,38 @@ class BuildSession:
         inexact, the whole context is flagged dirty once (so the next
         build re-scans everything and a watch loop rebuilds), and a
         fresh walk baseline is seeded so tracking resumes."""
+        gap_dirty: set[str] = set()
+        if self._gap_delta_pending and self.snapshot is not None:
+            # Restored session: the persisted baseline certifies the
+            # state at snapshot time — one delta against it surfaces
+            # everything that moved in the snapshot→restore gap at the
+            # same trust level the live mtime-walk fallback has. Only
+            # after it runs may a (freshly created, gap-blind) watcher
+            # be believed.
+            self._gap_delta_pending = False
+            try:
+                self.snapshot, delta = walk_mod.snapshot_delta(
+                    self.snapshot, self._walk_blacklist)
+            except OSError:
+                self.snapshot = None
+                self.exact = False
+                self.pending_dirty.add(self.context_dir)
+                self._snap_walk_all = True
+                return {self.context_dir}
+            gap_dirty = delta.dirty
+            self.pending_dirty |= gap_dirty
+            self._snap_walk_dirty |= gap_dirty
         if self.watcher is not None and self.watcher.healthy:
             got = self.watcher.collect()
             if got is not None:
                 self.pending_dirty |= got
+                self._snap_gap_paths += len(got)
                 # New dirs appeared? Register their watches BEFORE the
                 # caller scans, so edits inside them during the build
                 # are evented (no-op without structural churn).
                 self.watcher.resync()
                 if self.watcher.healthy:
-                    return got
+                    return got | gap_dirty
             # Overflow / read error / resync failure: the watcher is
             # dead — release its fd + kernel watches (a long-lived
             # worker must not pin inotify limits on corpses) and fall
@@ -451,9 +531,11 @@ class BuildSession:
                 self.snapshot = None
                 self.exact = False
                 self.pending_dirty.add(self.context_dir)
+                self._snap_walk_all = True
                 return {self.context_dir}
             self.pending_dirty |= delta.dirty
-            return delta.real_dirty
+            self._snap_walk_dirty |= delta.dirty
+            return delta.real_dirty | gap_dirty
         # No baseline: what changed since the last certified point is
         # unknowable — flag everything once and re-baseline. The
         # baseline walk (a full lstat pass) only runs when residency
@@ -483,6 +565,7 @@ class BuildSession:
         self.builds += 1
         self.last_used_mono = time.monotonic()
         self._resident_hint = resident_process or self.builds >= 2
+        self.storage_dir = ctx.image_store.root
         self._walk_blacklist = [
             p for p in (list(ctx.base_blacklist)
                         + [ctx.image_store.root])
@@ -508,12 +591,23 @@ class BuildSession:
         if ignore_sig != self._ignore_sig:
             if self._ignore_sig is not None or ignore_sig is not None:
                 self.scan_memo.clear()
+                self._snap_scan_dirty = True
             self._ignore_sig = ignore_sig
         # Adopt or install the resident content-ID cache.
         if self.content_ids is None:
             self.content_ids = ctx.content_ids
         else:
             ctx.content_ids = self.content_ids
+        # Snapshot-restored stat entries merge on first use —
+        # setdefault semantics (local knowledge wins), and every
+        # adopted entry still faces the per-lookup stat comparison and
+        # racily-clean window, so a stale restored entry re-hashes
+        # instead of replaying.
+        if self._restored_stat_entries is not None:
+            merge = getattr(self.content_ids, "merge_entries", None)
+            if merge is not None:
+                merge(self._restored_stat_entries)
+            self._restored_stat_entries = None
         begin = getattr(self.content_ids, "begin_build", None)
         if begin is not None:
             begin()
@@ -522,6 +616,13 @@ class BuildSession:
         if resident_process:
             self.content_ids.defer_save = True
         mode = "resident" if self.exact else "rescan"
+        if self.restored:
+            # First build after a snapshot restore: same residency
+            # semantics as the mode it shadows (dirty_exact still
+            # gates the scan memo), but reported distinctly so the
+            # fleet can tell a hand-off from a resident hit.
+            mode = "restored"
+            self.restored = False
         ctx.session = self
         ctx.dirty_paths = frozenset(self.pending_dirty)
         ctx.dirty_exact = self.exact
@@ -556,6 +657,7 @@ class BuildSession:
                     self.exact = False
                 else:
                     self.pending_dirty |= raced
+                    self._snap_gap_paths += len(raced)
                     self.exact = True
             else:
                 # mtime-walk fallback: the baseline captured at
@@ -571,24 +673,62 @@ class BuildSession:
             self.snapshot = None
             self.pending_dirty.clear()
             self.scan_memo.clear()
+            self._snap_scan_dirty = True
+            self._snap_walk_all = True
         # The per-build context must not leak a dead session reference.
         ctx.session = None
         ctx.dirty_paths = frozenset()
         ctx.dirty_exact = False
+        if ok:
+            self.checkpoint()
+
+    def checkpoint(self, force: bool = False) -> dict | None:
+        """Write this session's snapshot through the chunk CAS
+        (worker/snapshots.py). Incremental — only dirty shards
+        re-chunk — and advisory: any failure costs durability, never
+        the build. ``force`` (the worker's POST /sessions/snapshot and
+        the drain hand-off) checkpoints even sessions the auto policy
+        would skip."""
+        policy = snapshot_policy()
+        if policy == "0" or not self.portable_identity \
+                or not self.storage_dir:
+            return None
+        if not force and policy == "auto" and not self._resident_hint:
+            return None
+        from makisu_tpu.worker import snapshots as snapshots_mod
+        recipe = snapshots_mod.write_snapshot(self, self.storage_dir)
+        mgr = manager()
+        if recipe is None:
+            mgr.note_snapshot("write_error",
+                              context=self.context_dir)
+        else:
+            mgr.note_snapshot("write", context=self.context_dir)
+        return recipe
 
     # -- memo surfaces (called via ctx by steps/memfs/node) --
 
     def scan_lookup(self, source: str, checksum_in: int):
-        return self.scan_memo.get((source, checksum_in))
+        key = (source, checksum_in)
+        hit = self.scan_memo.get(key)
+        if hit is not None:
+            # Recency bump (dict insertion order IS the LRU order): a
+            # hot key replayed every build must not be evicted by a
+            # burst of one-shot keys that arrived after it.
+            self.scan_memo.pop(key)
+            self.scan_memo[key] = hit
+        return hit
 
     def scan_store(self, source: str, checksum_in: int,
                    checksum_out: int, files: int, nbytes: int) -> None:
         if len(self.scan_memo) >= _SCAN_MEMO_KEEP:
-            # Insertion-order eviction: stale (source, checksum) keys
-            # from superseded chains age out first.
+            # Recency-order eviction: the front of the dict is the
+            # least recently stored OR replayed key (scan_lookup
+            # re-inserts on hit), so stale keys from superseded chains
+            # age out first and hot keys survive one-shot bursts.
             self.scan_memo.pop(next(iter(self.scan_memo)))
         self.scan_memo[(source, checksum_in)] = (
             checksum_out, files, nbytes)
+        self._snap_scan_dirty = True
 
     def replay_lookup(self, key: tuple[str, str]):
         return self.layer_replay.get(key)
@@ -627,6 +767,24 @@ class SessionManager:
         self._mu = threading.Lock()
         self._sessions: dict[str, BuildSession] = {}
         self.invalidations: dict[str, int] = {}
+        # Snapshot-plane accounting (durable for the life of the
+        # worker, unlike the event-bus ledger): what /healthz exports
+        # and `doctor --fleet`'s snapshot_restore_failed finding reads.
+        self.snapshot_counts: dict[str, int] = {}
+        self.last_restore_failure: dict = {}
+
+    def note_snapshot(self, event: str, context: str = "",
+                      reason: str = "") -> None:
+        """Count one snapshot-plane event (write / write_error /
+        restore / restore_refused / restore_error); failures retain
+        context + reason for the fleet doctor."""
+        with self._mu:
+            self.snapshot_counts[event] = \
+                self.snapshot_counts.get(event, 0) + 1
+            if event in ("restore_refused", "restore_error"):
+                self.last_restore_failure = {
+                    "context": context, "reason": reason,
+                    "ts": time.time()}
 
     def _invalidate_locked(self, key: str, reason: str) -> None:
         session = self._sessions.pop(key, None)
@@ -648,13 +806,18 @@ class SessionManager:
         metrics.global_registry().gauge_set(SESSION_RESIDENT_BYTES,
                                             total)
 
-    def acquire(self, context_dir: str,
-                identity: str) -> tuple["BuildSession | None", str]:
+    def acquire(self, context_dir: str, identity: str,
+                restore_spec: "tuple[str, str] | None" = None,
+                ) -> tuple["BuildSession | None", str]:
         """Lease the context's session for one build. Returns
         ``(session, verdict)`` where verdict is one of ``hit`` (a live
-        session was reused), ``miss`` (a new session was created), or
+        session was reused), ``restored`` (no resident session, but a
+        chunk-addressed snapshot passed every invalidation check and
+        was rebuilt), ``miss`` (a new session was created), or
         ``busy`` (another build holds it — caller proceeds without
-        residency)."""
+        residency). ``restore_spec`` is ``(storage_dir,
+        portable_identity)``; without it the snapshot plane is never
+        consulted."""
         context_dir = os.path.abspath(context_dir)
         key = os.path.realpath(context_dir)
         now = time.monotonic()
@@ -672,11 +835,44 @@ class SessionManager:
                 elif now - session.last_used_mono > session_ttl():
                     self._invalidate_locked(key, "ttl")
                     session = None
-            verdict = "hit" if session is not None else "miss"
-            if session is None:
-                session = BuildSession(context_dir, identity)
+            if session is not None:
+                if restore_spec is not None:
+                    session.portable_identity = restore_spec[1]
+                session.busy = True
+                self._publish_bytes_locked()
+                return session, "hit"
+        # Cold miss: consult the snapshot plane OUTSIDE the lock (the
+        # shard fetch may ride the fleet peer wire — a slow peer must
+        # not stall every other context's acquire).
+        restored = None
+        if restore_spec is not None and snapshot_policy() != "0":
+            restored = self._try_restore(context_dir, identity,
+                                         restore_spec)
+        with self._mu:
+            resident = self._sessions.get(key)
+            if resident is not None:
+                # A concurrent acquire of the same context won the
+                # race while we restored; the resident session is the
+                # single writer — ours is discarded.
+                if restored is not None:
+                    restored.close()
+                if resident.busy:
+                    return None, "busy"
+                session, verdict = resident, "hit"
+            else:
+                session = restored if restored is not None \
+                    else BuildSession(context_dir, identity)
+                verdict = "restored" if restored is not None \
+                    else "miss"
+                if restore_spec is not None:
+                    session.portable_identity = restore_spec[1]
                 self._sessions[key] = session
-                # Count-based LRU: evict the stalest idle session.
+                # Count-based LRU: evict the stalest idle session. A
+                # restore that pushed the count over budget labels its
+                # victims distinctly (lru_restore) so doctor can tell
+                # hand-off pressure from plain churn.
+                reason = ("lru_restore" if verdict == "restored"
+                          else "lru")
                 while len(self._sessions) > max(1, max_sessions()):
                     victims = sorted(
                         ((s.last_used_mono, k)
@@ -684,16 +880,62 @@ class SessionManager:
                          if k != key and not s.busy))
                     if not victims:
                         break
-                    self._invalidate_locked(victims[0][1], "lru")
+                    self._invalidate_locked(victims[0][1], reason)
             session.busy = True
             self._publish_bytes_locked()
         return session, verdict
+
+    def _try_restore(self, context_dir: str, identity: str,
+                     restore_spec: tuple) -> "BuildSession | None":
+        """Attempt a snapshot restore outside the manager lock (the
+        chunk fetch may ride the peer wire). Counts every outcome;
+        ``absent`` (no recipe) is a plain cold miss, not a failure."""
+        storage_dir, portable = restore_spec
+        from makisu_tpu.worker import snapshots as snapshots_mod
+        try:
+            session, reason = snapshots_mod.try_restore(
+                context_dir, identity, storage_dir, portable)
+        except Exception as exc:  # noqa: BLE001 - advisory plane
+            log.warning("session snapshot restore errored for %s: %s",
+                        context_dir, exc)
+            session, reason = None, "error"
+        if session is not None:
+            self.note_snapshot("restore", context=context_dir)
+            metrics.counter_add(metrics.SESSION_SNAPSHOT_RESTORES,
+                                result="ok")
+            ledger.record("session", context_dir, "restored",
+                          reason="snapshot",
+                          resident_bytes=session.resident_bytes())
+            log.info("build session restored from snapshot: %s "
+                     "(exact=%s layers=%d)", context_dir,
+                     session.exact, len(session.layer_replay))
+            return session
+        if reason:
+            event = ("restore_error" if reason == "error"
+                     else "restore_refused")
+            self.note_snapshot(event, context=context_dir,
+                               reason=reason)
+            metrics.counter_add(
+                metrics.SESSION_SNAPSHOT_RESTORES,
+                result="refused" if event == "restore_refused"
+                else "error", reason=reason)
+            ledger.record("session", context_dir, "restore_refused",
+                          reason=reason)
+            log.info("session snapshot restore refused for %s (%s)",
+                     context_dir, reason)
+        return None
 
     def release(self, session: BuildSession) -> None:
         key = os.path.realpath(session.context_dir)
         budget = max_resident_bytes()
         with self._mu:
             session.busy = False
+            # Byte-budget evictions caused by a freshly-restored
+            # session's resident bytes label lru_restore: the hand-off
+            # over-budgeted the worker, which is a sizing signal, not
+            # ordinary churn.
+            reason = "lru_restore" if session._restore_fresh else "lru"
+            session._restore_fresh = False
             # Byte budget: first shrink the releasing session's layer
             # memo, then evict whole idle sessions oldest-first.
             total = sum(s.resident_bytes()
@@ -710,7 +952,7 @@ class SessionManager:
                      if k != key and not s.busy))
                 if not victims:
                     break
-                self._invalidate_locked(victims[0][1], "lru")
+                self._invalidate_locked(victims[0][1], reason)
             self._publish_bytes_locked()
 
     def peek(self, context_dir: str) -> "BuildSession | None":
@@ -720,6 +962,16 @@ class SessionManager:
         key = os.path.realpath(os.path.abspath(context_dir))
         with self._mu:
             return self._sessions.get(key)
+
+    def storage_dir_for(self, context_dir: str) -> str:
+        """The storage dir the named context's resident session is
+        bound to ("" when no resident session, or none has built yet)
+        — the snapshot endpoints use it to pick the recipe's home
+        among a multi-storage worker's dirs."""
+        key = os.path.realpath(os.path.abspath(context_dir))
+        with self._mu:
+            session = self._sessions.get(key)
+            return session.storage_dir or "" if session else ""
 
     def invalidate(self, context_dir: str = "") -> int:
         """Explicit invalidation (the worker's POST endpoint). Empty
@@ -739,6 +991,23 @@ class SessionManager:
             self._publish_bytes_locked()
         return dropped
 
+    def snapshot_all(self, context_dir: str = "",
+                     force: bool = True) -> int:
+        """Checkpoint every idle resident session (or one context) to
+        the snapshot plane NOW — the worker's POST /sessions/snapshot
+        and the fleet's drain hand-off. Writes run outside the lock;
+        returns the number of sessions checkpointed."""
+        want = (os.path.realpath(os.path.abspath(context_dir))
+                if context_dir else "")
+        with self._mu:
+            candidates = [s for k, s in self._sessions.items()
+                          if not s.busy and (not want or k == want)]
+        done = 0
+        for session in candidates:
+            if session.checkpoint(force=force) is not None:
+                done += 1
+        return done
+
     def stats(self) -> dict:
         """The ``/healthz`` sessions section + ``GET /sessions``."""
         with self._mu:
@@ -747,6 +1016,8 @@ class SessionManager:
             # invalidation reason would otherwise mutate the dict mid-
             # iteration and 500 a health probe.
             invalidations = dict(self.invalidations)
+            snapshot_counts = dict(self.snapshot_counts)
+            last_failure = dict(self.last_restore_failure)
         sessions.sort(key=lambda s: s["context"])
         return {
             "count": len(sessions),
@@ -757,6 +1028,12 @@ class SessionManager:
             "max_sessions": max_sessions(),
             "max_resident_bytes": max_resident_bytes(),
             "ttl_seconds": session_ttl(),
+            "snapshot": {
+                **{k: snapshot_counts.get(k, 0)
+                   for k in ("write", "write_error", "restore",
+                             "restore_refused", "restore_error")},
+                "last_restore_failure": last_failure,
+            },
             "sessions": sessions,
         }
 
@@ -767,6 +1044,8 @@ class SessionManager:
                 session.close()
             self._sessions.clear()
             self.invalidations.clear()
+            self.snapshot_counts.clear()
+            self.last_restore_failure = {}
             self._publish_bytes_locked()
 
 

@@ -73,6 +73,7 @@ def test_census_totals_match_disk(tmp_path):
     assert out["schema"] == census_mod.CENSUS_SCHEMA
     assert out["planes"]["chunks"] == {
         "objects": 2, "bytes": 4000,
+        "snapshots": 0, "snapshot_bytes": 0,
         "age": {"1h": 2, "1d": 0, "1w": 0, "30d": 0, "older": 0}}
     assert out["planes"]["blobs"]["objects"] == 2
     assert out["planes"]["blobs"]["bytes"] == 580

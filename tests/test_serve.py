@@ -692,12 +692,17 @@ def test_lying_frame_never_installs(tmp_path):
 # -- fleet peer plane on the pack wire ---------------------------------------
 
 
-def test_fleet_peer_exchange_is_pack_granular(tmp_path):
+def test_fleet_peer_exchange_is_pack_granular(tmp_path, monkeypatch):
     """Drain the builder worker and rebuild on its sibling: the
     relocated build's chunks must arrive as ranged pack fetches
     (SERVE_PEER_PACK_REQUESTS, /packs on the serving side), NOT as
     per-chunk GETs — and fewer requests than chunks must hit the
-    wire."""
+    wire. The session-snapshot plane is disabled here: drain/prewarm
+    shard staging rides the per-chunk wire by design (shards are not
+    pack members), and this test pins the LAYER exchange in
+    isolation — the snapshot wire is covered by
+    tests/test_session_snapshot.py and loadgen --prewarm-smoke."""
+    monkeypatch.setenv("MAKISU_TPU_SESSION_SNAPSHOT", "0")
     from tests.test_fleet import (
         _Fleet,
         _build_argv,
