@@ -1504,6 +1504,53 @@ def _northstar_incremental() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _profile_round(sampler) -> dict:
+    """Continuous-profile record for the round: the process sampler
+    (makisu_tpu/utils/profiler.py) watches the whole CPU-plane run —
+    micro-sections plus the explain/northstar builds, which execute
+    in this process. The folded-stack artifact lands in
+    benchmarks/profiles/ next to the round's other evidence, and the
+    section carries the diff command against the PREVIOUS round's
+    artifact: after `history diff` flags a duration regression,
+    `makisu-tpu profile diff PREV NEW` names the frames whose
+    self-time share grew."""
+    from makisu_tpu.utils import profiler
+    if sampler is None:
+        return {"disabled": "MAKISU_TPU_PROFILE_HZ=0"}
+    doc = sampler.snapshot(command="bench")
+    if not doc.get("samples"):
+        return {"error": "no samples collected"}
+    out_dir = os.path.join(_REPO, "benchmarks", "profiles")
+    os.makedirs(out_dir, exist_ok=True)
+    previous = sorted(
+        name for name in os.listdir(out_dir)
+        if name.startswith("profile_") and name.endswith(".json"))
+    path = os.path.join(
+        out_dir, time.strftime("profile_%Y%m%dT%H%M%SZ.json",
+                               time.gmtime()))
+    profiler.write_artifact(path, doc)
+    total = doc["samples"]
+    frames = profiler.self_time_by_frame(doc)
+    top = sorted(sorted(frames), key=lambda f: -frames[f])[:3]
+    section = {
+        "artifact": os.path.relpath(path, _REPO),
+        "samples": total,
+        "hz": doc.get("hz", 0.0),
+        "overhead_fraction": doc.get("overhead_fraction", 0.0),
+        "phase_shares": {p: round(n / total, 4) for p, n in
+                         sorted((doc.get("phases") or {}).items())},
+        "top_frames": [{"frame": f,
+                        "share": round(frames[f] / total, 4)}
+                       for f in top],
+    }
+    if previous:
+        section["diff_hint"] = (
+            "makisu-tpu profile diff "
+            + os.path.join("benchmarks", "profiles", previous[-1])
+            + " " + section["artifact"])
+    return section
+
+
 def _bench_history_path() -> str:
     path = os.path.join(_REPO, "benchmarks", "history",
                         "history.jsonl")
@@ -1537,6 +1584,23 @@ def _history_tail(limit: int = 8) -> dict:
 
 
 def main() -> int:
+    # Arm the continuous sampler for the round before any section
+    # runs: the in-process builds (explain, northstar) then sample
+    # with phase attribution, and _profile_round writes the artifact
+    # the NEXT round's `profile diff` compares against. Guarded — a
+    # profiler-plane failure must never cost a bench number.
+    prof = None
+    try:
+        from makisu_tpu.utils import profiler as profiler_mod
+        if (profiler_mod.resolve_hz() > 0
+                and profiler_mod.process_profiler() is None):
+            prof = profiler_mod.SamplingProfiler(
+                hz=profiler_mod.resolve_hz())
+            prof.start()
+            profiler_mod.set_process_profiler(prof)
+    except Exception:  # noqa: BLE001 - forensics must not fail bench
+        prof = None
+
     baseline = _cpu_baseline_gbps()
     errors: list[str] = []
     tpu_timeout = float(os.environ.get("MAKISU_BENCH_TPU_TIMEOUT", "900"))
@@ -1760,6 +1824,19 @@ def main() -> int:
             }
     except Exception as e:  # noqa: BLE001 - informational section
         record["device_sessions"] = {"error": str(e)[:200]}
+    # Continuous-profile section: where the round's CPU-plane wall
+    # clock went (phase shares + hottest frames), the folded-stack
+    # artifact in benchmarks/profiles/, and the `profile diff`
+    # command against the previous round's artifact.
+    try:
+        record["profile"] = _profile_round(prof)
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["profile"] = {"error": str(e)[:200]}
+    finally:
+        if prof is not None:
+            prof.stop()
+            from makisu_tpu.utils import profiler as profiler_mod
+            profiler_mod.set_process_profiler(None)
     if errors:
         record["error"] = "; ".join(errors)
     print(json.dumps(record))

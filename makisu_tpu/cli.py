@@ -115,6 +115,19 @@ def make_parser() -> argparse.ArgumentParser:
     parser.add_argument("--jax-profile", default="", metavar="DIR",
                         help="capture a JAX/XLA profiler trace (xprof) of "
                              "the accelerator hashing path into DIR")
+    parser.add_argument("--profile-hz", type=float, default=None,
+                        metavar="HZ",
+                        help="wall-clock sampling profiler rate for this "
+                             "command (default ~67 Hz, env "
+                             "MAKISU_TPU_PROFILE_HZ; 0 disables). The "
+                             "sampler self-measures its overhead and "
+                             "throttles to stay under a 2%% budget")
+    parser.add_argument("--profile-out", default="", metavar="FILE",
+                        help="write the sampled profile (schema "
+                             "makisu-tpu.profile.v1: phase-attributed "
+                             "folded stacks + embedded speedscope JSON) "
+                             "to FILE when the command finishes — the "
+                             "input `makisu-tpu profile` renders")
     sub = parser.add_subparsers(dest="command")
 
     build = sub.add_parser("build", help="build a docker image")
@@ -517,6 +530,12 @@ def make_parser() -> argparse.ArgumentParser:
                              "failover attempts as sibling subtrees); "
                              "the top-level --trace-out writes the "
                              "merged Perfetto export")
+    report.add_argument("--profile", default="", metavar="FILE",
+                        help="with --fleet: a makisu-tpu.profile.v1 "
+                             "artifact (e.g. `profile --fleet --out`) "
+                             "to render beside the span analysis — "
+                             "the sampled where-did-the-cycles-go "
+                             "view next to the declared one")
 
     explain = sub.add_parser(
         "explain", help="chunk-level cache miss attribution from a "
@@ -615,6 +634,39 @@ def make_parser() -> argparse.ArgumentParser:
     du.add_argument("--json", action="store_true", dest="json_out",
                     help="machine-readable census document "
                          "(makisu-tpu.census.v1)")
+
+    profile = sub.add_parser(
+        "profile", help="render, capture, diff, and aggregate "
+                        "wall-clock sampling profiles "
+                        "(makisu-tpu.profile.v1)")
+    profile.add_argument("target", nargs="*", default=[],
+                         help="a profile artifact to render; "
+                              "`diff BASELINE CANDIDATE` to attribute "
+                              "a regression to the frames whose "
+                              "self-time share grew; with --fleet, the "
+                              "front door socket/address to capture "
+                              "a merged cross-worker profile from")
+    profile.add_argument("--top", type=int, default=10,
+                         help="functions to list per table (default 10)")
+    profile.add_argument("--threshold", type=float, default=0.1,
+                         metavar="FRACTION",
+                         help="diff: flag frames whose self-time share "
+                              "grew by more than this fraction of "
+                              "total samples (default 0.1 = ten "
+                              "share points); exit 1 when any do")
+    profile.add_argument("--flame", default="", metavar="FILE",
+                         help="also write a self-contained flamegraph "
+                              "HTML (phase-colored icicle) to FILE")
+    profile.add_argument("--fleet", action="store_true",
+                         help="TARGET is a fleet front door: ask every "
+                              "alive worker for an on-demand "
+                              "--seconds capture window and render "
+                              "the merged profile")
+    profile.add_argument("--seconds", type=float, default=5.0,
+                         help="capture window for --fleet (default 5)")
+    profile.add_argument("--out", default="", metavar="FILE",
+                         help="also write the (merged) profile "
+                              "artifact to FILE")
 
     sub.add_parser("version", help="print the build version")
     return parser
@@ -1148,7 +1200,17 @@ def cmd_report(args) -> int:
                 f"{args.metrics_file}: no span events to assemble "
                 f"(expected a fleet --events-out log with "
                 f"span_start/span_end lines)")
-        print(traceexport.render_fleet_report(assembled), end="")
+        fleet_profile = None
+        if getattr(args, "profile", ""):
+            from makisu_tpu.utils import profiler as profiler_mod
+            try:
+                fleet_profile = profiler_mod.read_artifact(args.profile)
+            except ValueError as e:
+                log.error("%s", e)
+                raise SystemExit(2)
+        print(traceexport.render_fleet_report(assembled,
+                                              profile=fleet_profile),
+              end="")
         if args.trace_out:
             metrics.write_json_atomic(
                 args.trace_out,
@@ -1774,6 +1836,72 @@ def cmd_history(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Work with wall-clock sampling profiles: ``profile ARTIFACT``
+    renders the phase-attributed breakdown (``--flame`` adds a
+    self-contained flamegraph HTML); ``profile diff BASELINE
+    CANDIDATE`` attributes a regression to the frames whose self-time
+    share grew; ``profile --fleet SOCKET`` captures and merges an
+    on-demand window from every alive worker. Exit codes follow the
+    ``history diff`` gate contract: 0 = ok, 1 = a frame regressed
+    beyond ``--threshold``, 2 = unreadable input."""
+    from makisu_tpu.utils import profiler as profiler_mod
+    tokens = args.target
+
+    def read(path: str) -> dict:
+        try:
+            return profiler_mod.read_artifact(path)
+        except ValueError as e:
+            log.error("%s", e)
+            raise SystemExit(2)
+
+    if args.fleet:
+        from makisu_tpu.worker import WorkerClient
+        if not tokens:
+            raise SystemExit(
+                "profile --fleet needs the front door's socket path: "
+                "`makisu-tpu profile --fleet SOCKET`")
+        client = WorkerClient(tokens[0],
+                              control_timeout=args.seconds + 30.0)
+        try:
+            doc = client.profile(seconds=args.seconds)
+        except (OSError, RuntimeError, ValueError) as e:
+            raise SystemExit(
+                f"fleet profile capture from {tokens[0]} failed: {e}")
+    elif tokens and tokens[0] == "diff":
+        if len(tokens) != 3:
+            raise SystemExit(
+                "profile diff takes exactly two artifacts: "
+                "`makisu-tpu profile diff BASELINE CANDIDATE`")
+        result = profiler_mod.diff(read(tokens[1]), read(tokens[2]),
+                                   threshold=args.threshold)
+        print(profiler_mod.render_diff(result), end="")
+        return 0 if result["ok"] else 1
+    elif len(tokens) == 1:
+        doc = read(tokens[0])
+    else:
+        raise SystemExit(
+            "profile takes one artifact path, `diff BASELINE "
+            "CANDIDATE`, or `--fleet SOCKET`")
+    print(profiler_mod.render_profile(doc, top=args.top), end="")
+    if args.flame:
+        try:
+            with open(args.flame, "w", encoding="utf-8") as f:
+                f.write(profiler_mod.flamegraph_html(doc))
+            log.info("flamegraph written to %s", args.flame)
+        except OSError as e:
+            log.error("failed to write flamegraph: %s", e)
+            return 1
+    if args.out:
+        try:
+            profiler_mod.write_artifact(args.out, doc)
+            log.info("profile artifact written to %s", args.out)
+        except OSError as e:
+            log.error("failed to write profile artifact: %s", e)
+            return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = make_parser()
     args = parser.parse_args(argv)
@@ -1808,7 +1936,7 @@ def main(argv: list[str] | None = None) -> int:
                 "check": cmd_check, "top": cmd_top,
                 "alerts": cmd_alerts, "sessions": cmd_sessions,
                 "loadgen": cmd_loadgen, "history": cmd_history,
-                "du": cmd_du}
+                "du": cmd_du, "profile": cmd_profile}
     handler = handlers.get(args.command)
     if handler is None:
         parser.print_help()
@@ -1893,6 +2021,24 @@ def main(argv: list[str] | None = None) -> int:
     # handler threads, where install_signal_dumps is a no-op.
     old_signal_handlers = flightrecorder.install_signal_dumps(
         recorder, registry, args.diag_out, tag=registry.trace_id[:8])
+    # Continuous profiling: real-work commands run under the wall-clock
+    # sampler. This invocation's thread is bound to its trace id so the
+    # sampler attributes its stacks to THIS build even inside a busy
+    # worker; a process-level sampler (the worker's, or loadgen's) is
+    # reused rather than double-sampled — ownership decides who stops
+    # it and clears the registry slot.
+    from makisu_tpu.utils import profiler as profiler_mod
+    sampler = None
+    sampler_thread_token = None
+    if args.command in ("build", "pull", "push", "diff", "loadgen"):
+        sampler_thread_token = profiler_mod.bind_thread(
+            registry.trace_id)
+        if profiler_mod.process_profiler() is None:
+            sample_hz = profiler_mod.resolve_hz(args.profile_hz)
+            if sample_hz > 0:
+                sampler = profiler_mod.SamplingProfiler(
+                    hz=sample_hz).start()
+                profiler_mod.set_process_profiler(sampler)
     events_writer = None
     events_token = None
     if args.events_out:
@@ -2018,6 +2164,28 @@ def main(argv: list[str] | None = None) -> int:
             profiler.disable()
             profiler.dump_stats("/tmp/makisu-tpu.prof")
             log.info("cpu profile written to /tmp/makisu-tpu.prof")
+        if sampler_thread_token is not None:
+            profiler_mod.unbind_thread(sampler_thread_token)
+        if sampler is not None:
+            # Stop BEFORE snapshotting so the artifact's duration is
+            # the command's, not the teardown's.
+            sampler.stop()
+        active_sampler = sampler or profiler_mod.process_profiler()
+        if args.profile_out:
+            if active_sampler is not None:
+                try:
+                    profiler_mod.write_artifact(
+                        args.profile_out, active_sampler.snapshot(
+                            command=args.command or ""))
+                    log.info("profile written to %s", args.profile_out)
+                except OSError as e:
+                    log.error("failed to write profile: %s", e)
+            else:
+                log.info("profile requested but the sampler is "
+                         "disabled (--profile-hz 0 / "
+                         "MAKISU_TPU_PROFILE_HZ=0)")
+        if sampler is not None:
+            profiler_mod.set_process_profiler(None)
         if args.command == "build":
             # One greppable line with the build's vital signs; the full
             # breakdown lives in --metrics-out / the worker's /metrics.

@@ -195,6 +195,36 @@ def diagnose_fleet(health: dict,
                           f"entries seeded) — eviction dry-runs "
                           f"refuse until the seed completes",
             })
+    # 5a. Continuous-profiling vitals: each worker's /healthz carries
+    # its sampler digest. A sampler past its overhead budget is
+    # charging builds for its own observation; dropped stacks mean the
+    # bounded fold table overflowed and the profile under-reports.
+    for w in alive:
+        wid = w.get("id", "?")
+        prof = w.get("profiler") or {}
+        if not prof.get("enabled"):
+            continue
+        overhead = float(prof.get("overhead_fraction", 0.0) or 0.0)
+        if overhead > 0.02:
+            findings.append({
+                "severity": "warning",
+                "kind": "profiler_overhead",
+                "worker": wid,
+                "detail": f"worker {wid}'s profiler measures "
+                          f"{100.0 * overhead:.1f}% overhead (budget "
+                          f"2%) at {prof.get('hz', 0):g} Hz — lower "
+                          f"MAKISU_TPU_PROFILE_HZ there",
+            })
+        dropped = int(prof.get("dropped", 0) or 0)
+        if dropped:
+            findings.append({
+                "severity": "info",
+                "kind": "profiler_dropped",
+                "worker": wid,
+                "detail": f"worker {wid}'s profiler dropped {dropped} "
+                          f"sample(s) at its folded-stack cap — its "
+                          f"profiles under-report the long tail",
+            })
     # 5b. Session-snapshot restore failures: each worker's fleet row
     # carries the snapshot-plane digest captured from its /sessions
     # poll (write/restore tallies + the last restore failure). A

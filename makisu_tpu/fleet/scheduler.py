@@ -178,6 +178,11 @@ class WorkerState:
         # ({"active": n, "page": n, "warn": n}) — what `top`'s ALERTS
         # column and doctor's fleet view read without a /alerts fan-out.
         self.alerts: dict = {}
+        # Continuous-profiling digest from /healthz ({"enabled", "hz",
+        # "samples_total", "dropped", "overhead_fraction", ...}) — lets
+        # doctor --fleet flag a sampler past its overhead budget or
+        # dropping stacks without a per-worker /profile fan-out.
+        self.profiler: dict = {}
         # Local estimate: builds this front door currently has open
         # against the worker (fresher than any poll).
         self.local_inflight = 0
@@ -214,6 +219,7 @@ class WorkerState:
             "builds_failed": self.builds_failed,
             "health_score": round(self.health_score, 4),
             "alerts": dict(self.alerts),
+            "profiler": dict(self.profiler),
             "routed_total": self.routed_total,
             "consecutive_failures": self.consecutive_failures,
             "last_error": self.last_error,
@@ -357,6 +363,7 @@ class FleetScheduler:
                 state.serve = dict(health.get("serve") or {})
                 state.storage = dict(health.get("storage") or {})
                 state.alerts = dict(health.get("alerts") or {})
+                state.profiler = dict(health.get("profiler") or {})
                 if not was_alive:
                     self._peer_version += 1  # membership changed
                 else:

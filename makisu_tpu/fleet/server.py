@@ -118,6 +118,21 @@ class _FleetHandler(BaseHTTPRequestHandler):
         elif self.path == "/alerts":
             self._respond(200, json.dumps(server.alerts()).encode(),
                           content_type="application/json")
+        elif self.path == "/profile" or self.path.startswith("/profile?"):
+            # Fleet-wide on-demand profiling: every alive worker
+            # captures a ?seconds=N window in parallel and the merged
+            # makisu-tpu.profile.v1 comes back — one request answers
+            # "where is the FLEET's time going right now".
+            from urllib.parse import parse_qs, urlsplit
+            query = parse_qs(urlsplit(self.path).query)
+            try:
+                seconds = float((query.get("seconds") or ["5"])[0])
+            except ValueError:
+                self._respond(400, b"bad seconds")
+                return
+            self._respond(200,
+                          json.dumps(server.profile(seconds)).encode(),
+                          content_type="application/json")
         elif self.path == "/exit":
             threading.Thread(target=server.shutdown,
                              daemon=True).start()
@@ -311,6 +326,22 @@ class FleetServer(socketserver.ThreadingMixIn,
             webhook=alert_webhook, source="fleet")
         self.canary.start()
         self.slo.start()
+        # Continuous profiling: the front door samples its own process
+        # too (routing, forwarding, canaries), ownership-gated exactly
+        # like the worker — in an in-process fleet the first server
+        # armed the sampler and everyone shares it. A firing
+        # page-severity fleet alert snapshots it next to the bundles.
+        from makisu_tpu.utils import profiler as profiler_mod
+        self._diag_out = diag_out
+        self._profiler_owner = False
+        self.profiler = profiler_mod.process_profiler()
+        profile_hz = profiler_mod.resolve_hz()
+        if self.profiler is None and profile_hz > 0:
+            self.profiler = profiler_mod.SamplingProfiler(
+                hz=profile_hz).start()
+            profiler_mod.set_process_profiler(self.profiler)
+            self._profiler_owner = True
+        self.slo.manager.on_fire = self._profile_on_page
 
     def get_request(self):
         request, _ = super().get_request()
@@ -332,6 +363,11 @@ class FleetServer(socketserver.ThreadingMixIn,
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        from makisu_tpu.utils import profiler as profiler_mod
+        if self._profiler_owner and self.profiler is not None:
+            self.profiler.stop()
+            if profiler_mod.process_profiler() is self.profiler:
+                profiler_mod.set_process_profiler(None)
         self.slo.stop()
         self.canary.stop()
         events.remove_global_sink(self._collector_sink)
@@ -823,6 +859,60 @@ class FleetServer(socketserver.ThreadingMixIn,
         out["workers"] = workers
         return out
 
+    def profile(self, seconds: float) -> dict:
+        """``GET /profile?seconds=N``: ask every alive worker for an
+        on-demand capture window in parallel (same fan-out discipline
+        as /metrics — a dead worker costs its own timeout, never the
+        round) and merge the answers into one fleet-wide
+        ``makisu-tpu.profile.v1`` document with per-worker vitals."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from makisu_tpu.utils import profiler as profiler_mod
+        from makisu_tpu.worker.client import WorkerClient
+        seconds = min(max(float(seconds), 0.1), 30.0)
+        stats = self.scheduler.stats()
+        alive = [w for w in stats["workers"] if w["alive"]]
+
+        def capture(w):
+            client = WorkerClient(w["socket"], connect_timeout=2.0,
+                                  control_timeout=10.0, retries=0)
+            try:
+                return w, client.profile(seconds=seconds)
+            except (OSError, RuntimeError, ValueError):
+                return w, None
+
+        if alive:
+            with ThreadPoolExecutor(min(8, len(alive))) as pool:
+                fetched = list(pool.map(capture, alive))
+        else:
+            fetched = []
+        docs = {w["id"]: doc for w, doc in fetched if doc is not None}
+        merged = profiler_mod.merge_profiles(docs)
+        merged["unreachable"] = sorted(
+            w["id"] for w, doc in fetched if doc is None)
+        return merged
+
+    def profiler_health(self) -> dict:
+        if self.profiler is None:
+            return {"enabled": False, "hz": 0.0, "samples_total": 0,
+                    "dropped": 0, "throttled": 0, "distinct_stacks": 0,
+                    "overhead_fraction": 0.0}
+        return self.profiler.stats()
+
+    def _profile_on_page(self, payload: dict) -> None:
+        """AlertManager ``on_fire`` hook: a page-severity fleet alert
+        writes the front door's sampler snapshot beside the bundles."""
+        from makisu_tpu.utils import flightrecorder
+        from makisu_tpu.utils import profiler as profiler_mod
+        sampler = self.profiler
+        if sampler is None or not sampler.samples_total:
+            return
+        rule = str(payload.get("rule", "page")).replace("/", "_")
+        profiler_mod.write_artifact(
+            flightrecorder.forced_profile_path(
+                self._diag_out, f"alert-{rule}"),
+            sampler.snapshot(command=f"alert-{rule}"))
+
     def health(self) -> dict:
         """Worker-shaped ``/healthz`` (so ``top`` and WorkerClient
         work against the fleet socket) plus the ``fleet`` section and
@@ -891,6 +981,7 @@ class FleetServer(socketserver.ThreadingMixIn,
             },
             "fleet": stats,
             "alerts": self.slo.manager.digest(),
+            "profiler": self.profiler_health(),
             "self": self_section,
         }
 

@@ -68,6 +68,7 @@ import time
 
 from makisu_tpu.utils import logging as log
 from makisu_tpu.utils import metrics
+from makisu_tpu.utils import profiler
 
 LOADGEN_SCHEMA = "makisu-tpu.loadgen.v1"
 
@@ -215,6 +216,7 @@ def run(args) -> int:
     sampler = None
     metrics_text = ""
     final_health: dict = {}
+    profile_doc: dict | None = None
     wall = 0.0
     socket_path = args.socket
     templates: list[str] = []
@@ -327,6 +329,13 @@ def run(args) -> int:
             final_health = dict(client.healthz())
         except (OSError, RuntimeError):
             pass
+        # Snapshot the continuous profile BEFORE teardown: when the
+        # spawned worker armed the process sampler, server_close()
+        # stops it (the builds ran on its handler threads in this
+        # process, so the sampler saw them).
+        prof = profiler.process_profiler()
+        if prof is not None and prof.samples_total:
+            profile_doc = prof.snapshot(command="loadgen")
     finally:
         if sampler is not None:
             sampler.stop()
@@ -337,7 +346,7 @@ def run(args) -> int:
             shutil.rmtree(work_dir, ignore_errors=True)
 
     report = _build_report(args, results, sampler, metrics_text,
-                           final_health, wall, tenants)
+                           final_health, wall, tenants, profile_doc)
     if args.report:
         metrics.write_json_atomic(args.report, report)
         log.info("loadgen report written to %s", args.report)
@@ -345,8 +354,33 @@ def run(args) -> int:
     return 0 if report["failures"] == 0 and results else 1
 
 
+def _profile_digest(doc: dict | None) -> dict | None:
+    """Compact continuous-profiling section for the loadgen report:
+    sampler vitals, phase shares, and the top self-time frames. The
+    full artifact (folded stacks + speedscope) goes to --profile-out;
+    the report carries just enough to spot where the run burned its
+    wall clock."""
+    if not doc or not doc.get("samples"):
+        return None
+    total = doc["samples"] or 1
+    phases = doc.get("phases") or {}
+    frames = profiler.self_time_by_frame(doc)
+    top = sorted(sorted(frames), key=lambda f: -frames[f])[:5]
+    return {
+        "samples": doc["samples"],
+        "hz": doc.get("hz", 0.0),
+        "dropped": doc.get("dropped", 0),
+        "overhead_fraction": doc.get("overhead_fraction", 0.0),
+        "phase_shares": {p: round(n / total, 4)
+                         for p, n in sorted(phases.items())},
+        "top_frames": [{"frame": f,
+                        "share": round(frames[f] / total, 4)}
+                       for f in top],
+    }
+
+
 def _build_report(args, results, sampler, metrics_text, final_health,
-                  wall, tenants) -> dict:
+                  wall, tenants, profile_doc=None) -> dict:
     ok = [r for r in results if r["exit_code"] == 0]
     latencies = [r["latency_seconds"] for r in ok]
     waits = [r["queue_wait_seconds"] for r in ok]
@@ -400,6 +434,7 @@ def _build_report(args, results, sampler, metrics_text, final_health,
         "saw_running_build": sampler.saw_running_build,
         "cache_trajectory": sampler.samples,
         "worker_health": final_health,
+        "profile": _profile_digest(profile_doc),
         "results": results,
     }
 
@@ -450,6 +485,21 @@ def render_report(report: dict) -> str:
             f"{len(traj)} samples")
     lines.append(f"  peak in-flight {report['peak_inflight']}, "
                  f"peak queue depth {report['peak_queue_depth']}")
+    prof = report.get("profile")
+    if prof:
+        shares = "  ".join(
+            f"{p} {100.0 * s:.0f}%"
+            for p, s in sorted(prof["phase_shares"].items(),
+                               key=lambda kv: -kv[1]) if s >= 0.005)
+        lines.append(
+            f"  profile: {prof['samples']} samples @ "
+            f"{prof['hz']:g} Hz  (overhead "
+            f"{100.0 * prof['overhead_fraction']:.2f}%)  {shares}")
+        if prof["top_frames"]:
+            hot = prof["top_frames"][0]
+            lines.append(
+                f"    hottest frame {hot['frame']} "
+                f"({100.0 * hot['share']:.1f}% self time)")
     fleet = report.get("fleet")
     if fleet:
         lines.append("  fleet:")

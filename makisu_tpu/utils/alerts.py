@@ -132,6 +132,13 @@ class AlertManager:
         self._states: dict[tuple[str, str], _AlertState] = {}
         self._recent: collections.deque[dict] = collections.deque(
             maxlen=_RECENT_KEEP)
+        # Optional fired-hook for page-severity transitions: the
+        # worker/fleet front door attach a profile-tail dump here, so
+        # the moment a page fires there is a "where was the time going"
+        # artifact next to the alert. Called outside the lock;
+        # exceptions are swallowed (forensics never wedges the
+        # evaluator).
+        self.on_fire = None
 
     # -- state machine ----------------------------------------------------
 
@@ -240,6 +247,13 @@ class AlertManager:
                         rule=rule, severity=severity)
         if self.webhook:
             self._post_webhook(transition, payload)
+        if (transition == "fired" and severity == "page"
+                and self.on_fire is not None):
+            try:
+                self.on_fire(payload)
+            except Exception:  # noqa: BLE001 - hook never wedges alerts
+                events.emit("alert_hook_error",
+                            rule=payload.get("rule", "?"))
 
     def _post_webhook(self, transition: str, payload: dict) -> None:
         """One bounded POST per transition. Failures are counted, not

@@ -166,6 +166,31 @@ def forced_bundle_path(diag_out: str, reason: str, tag: str = "") -> str:
                          _bundle_name(reason, tag)))
 
 
+def forced_profile_path(diag_out: str, reason: str, tag: str = "") -> str:
+    """Where an on-demand profile snapshot (SIGUSR2) lands: next to an
+    explicit ``--diag-out`` bundle (never ON it — the profile must not
+    clobber captured forensics), else ``$MAKISU_TPU_DIAG_DIR``, else
+    the tempdir. Never None, same contract as
+    :func:`forced_bundle_path`."""
+    middle = f"{tag}-" if tag else ""
+    name = f"makisu-tpu-profile-{os.getpid()}-{middle}{reason}.json"
+    if diag_out:
+        parent = os.path.dirname(diag_out) or "."
+        try:
+            os.makedirs(parent, exist_ok=True)
+        except OSError:
+            pass
+        return os.path.join(parent, name)
+    diag_dir = os.environ.get("MAKISU_TPU_DIAG_DIR", "")
+    if diag_dir:
+        try:
+            os.makedirs(diag_dir, exist_ok=True)
+            return os.path.join(diag_dir, name)
+        except OSError:
+            pass
+    return os.path.join(tempfile.gettempdir(), name)
+
+
 class FlightRecorder:
     """Bounded in-memory record of one build (or one process, when
     armed globally by the worker). All appends are lock-free deque
@@ -247,6 +272,7 @@ class FlightRecorder:
             "transfer": _transfer_state(),
             "resources": resources.trajectory(),
             "device_probe": _device_probe_state(),
+            "profile": _profile_tail(),
         }
         out["metrics"] = _metrics_snapshot(reg)
         out.update(extra)
@@ -278,6 +304,21 @@ class FlightRecorder:
         return path
 
 
+def _profile_tail(limit: int = 40) -> dict | None:
+    """A trimmed snapshot of the process sampler for embedding in
+    diagnostic bundles: the hottest ``limit`` folded stacks plus the
+    sampler's vitals. None when no sampler is armed. Lock-free reads
+    only — bundles are assembled from signal handlers."""
+    from makisu_tpu.utils import profiler
+    sampler = profiler.process_profiler()
+    if sampler is None or not sampler.samples_total:
+        return None
+    doc = sampler.snapshot()
+    doc["stacks"] = doc["stacks"][:limit]
+    doc.pop("traces", None)
+    return doc
+
+
 def install(recorder: FlightRecorder) -> tuple:
     """Bind a recorder to the current context's event bus and log tap.
     Returns tokens for :func:`uninstall`."""
@@ -295,10 +336,12 @@ def install_signal_dumps(recorder: FlightRecorder,
                          registry: "metrics.MetricsRegistry | None",
                          diag_out: str, tag: str = "") -> dict:
     """Bind SIGTERM (dump, then unwind via ``SystemExit(143)`` so open
-    reports/logs still flush) and SIGUSR1 (dump and keep running —
-    live inspection) to ``recorder``. Main thread only — elsewhere
-    (worker build handler threads) this is a no-op. Returns the
-    replaced handlers for :func:`restore_signal_handlers`."""
+    reports/logs still flush), SIGUSR1 (dump and keep running — live
+    inspection), and SIGUSR2 (write the process sampler's profile
+    snapshot and keep running — on-demand "where is the time going"
+    without stopping the build) to ``recorder``. Main thread only —
+    elsewhere (worker build handler threads) this is a no-op. Returns
+    the replaced handlers for :func:`restore_signal_handlers`."""
     import signal
     old: dict = {}
     if threading.current_thread() is not threading.main_thread():
@@ -314,6 +357,27 @@ def install_signal_dumps(recorder: FlightRecorder,
         if exit_after:
             raise SystemExit(128 + signum)
 
+    def _profile_dump(signum, frame):
+        # Resolved at fire time, not registration time: the worker
+        # arms its sampler after installing handlers, and a build with
+        # --profile-hz 0 simply has nothing to dump.
+        from makisu_tpu.utils import profiler
+        name = signal.Signals(signum).name
+        sampler = profiler.process_profiler()
+        if sampler is None:
+            return
+        try:
+            profiler.write_artifact(
+                forced_profile_path(diag_out, name, tag=tag),
+                sampler.snapshot(command=name))
+        except Exception as e:  # noqa: BLE001 - forensics never kills work
+            # Signal context: the logging plane takes sink locks, so
+            # the trace goes straight to fd 2 (async-signal-safe).
+            try:
+                os.write(2, f"{name} profile dump failed: {e}\n".encode())
+            except OSError:
+                pass
+
     for sig, exit_after in ((signal.SIGTERM, True),
                             (signal.SIGUSR1, False)):
         try:
@@ -321,6 +385,11 @@ def install_signal_dumps(recorder: FlightRecorder,
                 sig, lambda s, f, e=exit_after: _dump(s, f, e))
         except (ValueError, OSError):  # pragma: no cover
             pass
+    try:
+        old[signal.SIGUSR2] = signal.signal(signal.SIGUSR2,
+                                            _profile_dump)
+    except (ValueError, OSError):  # pragma: no cover
+        pass
     return old
 
 
@@ -453,8 +522,9 @@ def _fmt_bytes(n: float) -> str:
 
 
 # Threads that exist BECAUSE of the forensics layer; never the wedge.
-_FORENSIC_THREADS = ("stall-watchdog", "resource-sampler")
-_FORENSIC_FILES = ("flightrecorder.py", "resources.py")
+_FORENSIC_THREADS = ("stall-watchdog", "resource-sampler",
+                     "profiler-sampler")
+_FORENSIC_FILES = ("flightrecorder.py", "resources.py", "profiler.py")
 
 
 def _thread_busy(thread: dict) -> bool:
@@ -606,6 +676,39 @@ def render_doctor(bundle: dict) -> str:
         elif state == "failed" and probe.get("detail"):
             diagnosis.append(
                 f"backend init failed: {probe['detail'][:120]}")
+
+    # -- continuous profile -----------------------------------------------
+    prof = bundle.get("profile") or {}
+    if prof.get("samples"):
+        from makisu_tpu.utils import profiler
+        total = prof["samples"]
+        lines.append("")
+        lines.append(
+            f"profile: {total} samples over "
+            f"{prof.get('duration_seconds', 0.0):.1f}s at "
+            f"{prof.get('hz', 0.0):g} Hz, sampler overhead "
+            f"{100.0 * prof.get('overhead_fraction', 0.0):.2f}%")
+        phases = prof.get("phases") or {}
+        for phase, count in sorted(phases.items(),
+                                   key=lambda kv: -kv[1])[:5]:
+            hot = profiler.dominant_frame(prof, phase)
+            detail = (f" — hottest frame {hot[0]} ({hot[1]} samples)"
+                      if hot else "")
+            lines.append(f"  {phase:<6s} {100.0 * count / total:5.1f}%"
+                         f"{detail}")
+        # A phase that owns most of the wall clock gets its hottest
+        # frame named in the verdict — the attribution `history diff`
+        # and SLO alerts can only gesture at.
+        top_phase, top_count = max(phases.items(),
+                                   key=lambda kv: kv[1],
+                                   default=("", 0))
+        hot = profiler.dominant_frame(prof, top_phase) \
+            if top_phase else None
+        if hot and top_count / total >= 0.5:
+            diagnosis.append(
+                f"phase '{top_phase}' dominates the profile "
+                f"({100.0 * top_count / total:.0f}% of samples), "
+                f"mostly in {hot[0]}")
 
     # -- resources --------------------------------------------------------
     samples = bundle.get("resources") or []

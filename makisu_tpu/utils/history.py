@@ -550,6 +550,12 @@ def render_diff(result: dict) -> str:
             + [f"storage:{g['plane']}" for g in growth])
         lines.append(f"REGRESSION: {names} beyond the "
                      f"{100.0 * result['threshold']:.0f}% threshold")
+        if any(r["metric"].startswith("duration")
+               for r in result["regressions"]):
+            lines.append(
+                "  attribute it to frames: capture profiles of both "
+                "builds (--profile-out) and run `makisu-tpu profile "
+                "diff BASELINE CANDIDATE`")
     else:
         lines.append("ok: no regression beyond the threshold")
     return "\n".join(lines) + "\n"

@@ -198,6 +198,15 @@ CANARY_LATENCY = "makisu_canary_latency_seconds"
 WORKER_HEALTH_SCORE = "makisu_worker_health_score"
 WORKER_UP = "makisu_worker_up"
 
+# Continuous profiling plane: the wall-clock sampler's own vitals —
+# cumulative samples, folded stacks dropped at the bounded-memory cap,
+# distinct stacks held, and the self-measured overhead fraction the
+# <2% budget is judged against. Exported ~1/s from the sampler thread.
+PROFILER_SAMPLES = "makisu_profiler_samples_total"
+PROFILER_DROPPED = "makisu_profiler_dropped_total"
+PROFILER_STACKS = "makisu_profiler_distinct_stacks"
+PROFILER_OVERHEAD = "makisu_profiler_overhead_ratio"
+
 
 def stage_busy_add(stage: str, seconds: float) -> None:
     """Charge ``seconds`` of busy time to one commit-pipeline stage.

@@ -457,13 +457,16 @@ def _find_spans(top: dict, name: str) -> list[dict]:
             if span.get("name") == name]
 
 
-def render_fleet_report(assembled: dict) -> str:
+def render_fleet_report(assembled: dict, profile: dict | None = None) -> str:
     """The ``makisu-tpu report --fleet`` output: per trace, the
     cross-process critical path (whose total is the front door's wall
     time — the root IS the fleet_build span), the admission economics
     side by side (front-door quota wait vs worker queue wait), per-
     attempt routing (failover attempts as sibling subtrees), build
-    phase self-times, and bytes on wire."""
+    phase self-times, and bytes on wire. ``profile`` (a merged
+    ``makisu-tpu.profile.v1`` document, e.g. from ``profile --fleet
+    --out``) appends the sampled where-did-the-cycles-go view beside
+    the span-declared one."""
     traces = assembled.get("traces", [])
     lines = [f"makisu-tpu fleet trace report — {len(traces)} "
              f"trace(s), {assembled.get('span_count', 0)} span(s)"]
@@ -529,6 +532,26 @@ def render_fleet_report(assembled: dict) -> str:
         lines.append("untraced wire bytes: " + "  ".join(
             f"{kind}={fmt_bytes(n)}"
             for kind, n in sorted(untraced.items())))
+    if profile and profile.get("samples"):
+        from makisu_tpu.utils import profiler
+        total = profile["samples"]
+        workers = profile.get("workers") or {}
+        lines.append("")
+        lines.append(
+            f"fleet profile: {total} samples"
+            + (f" across {len(workers)} worker(s)" if workers else "")
+            + f", sampler overhead "
+              f"{100.0 * profile.get('overhead_fraction', 0.0):.2f}%")
+        phases = profile.get("phases") or {}
+        if phases:
+            lines.append("  sampled phase shares: " + "  ".join(
+                f"{phase}={100.0 * phases.get(phase, 0) / total:.1f}%"
+                for phase in PHASES if phases.get(phase)))
+        for phase in sorted(phases):
+            hot = profiler.dominant_frame(profile, phase)
+            if hot:
+                lines.append(f"  {phase:<6s} hottest frame {hot[0]} "
+                             f"({hot[1]} samples)")
     return "\n".join(lines) + "\n"
 
 

@@ -486,6 +486,25 @@ class WorkerClient:
         finally:
             conn.close()
 
+    def profile(self, seconds: float = 5.0) -> dict:
+        """An on-demand profile capture (``GET /profile?seconds=N``):
+        the worker — or, through the front door, every alive worker
+        merged — samples for ``seconds`` and answers with a
+        ``makisu-tpu.profile.v1`` window. No retry (a timed-out
+        capture must not silently run twice), and the socket timeout
+        stretches past the window the server is deliberately
+        holding the request for."""
+        conn, resp = self._request(
+            "GET", f"/profile?seconds={float(seconds):g}",
+            timeout=self.control_timeout + float(seconds))
+        try:
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker /profile returned {resp.status}")
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
     def alerts(self) -> dict:
         """The ``GET /alerts`` payload: active + recently-resolved
         SLO alerts (worker or fleet server — both speak the same

@@ -429,6 +429,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200,
                           json.dumps(self.server.alerts()).encode(),
                           content_type="application/json")
+        elif self.path == "/profile" or self.path.startswith("/profile?"):
+            # On-demand profile capture: sample for ?seconds=N and
+            # answer with the makisu-tpu.profile.v1 window — what the
+            # worker did DURING the window, not since boot. Blocks
+            # this handler thread only; sampling (and every other
+            # endpoint) continues underneath.
+            from urllib.parse import parse_qs, urlsplit
+            query = parse_qs(urlsplit(self.path).query)
+            try:
+                seconds = float((query.get("seconds") or ["5"])[0])
+            except ValueError:
+                self._respond(400, b"bad seconds")
+                return
+            self._respond(
+                200,
+                json.dumps(self.server.profile(seconds)).encode(),
+                content_type="application/json")
         elif self.path == "/exit":
             # Shut down regardless of whether the response write lands
             # (clients may hang up as soon as the status line arrives).
@@ -874,6 +891,28 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             self._slo_probe, rules, interval=slo_interval,
             webhook=alert_webhook, source="worker")
         self.slo.start()
+        # Continuous profiling: one process-level wall-clock sampler
+        # for the worker's lifetime (env MAKISU_TPU_PROFILE_HZ; 0 =
+        # off). Ownership-gated: in an in-process fleet the FIRST
+        # server to start arms it and the siblings share it — every
+        # build's samples land in one process profile either way, and
+        # only the owner stops it at close. Builds bind their handler
+        # thread to their trace id (cli.main), so per-build phase
+        # attribution survives concurrency.
+        from makisu_tpu.utils import profiler as profiler_mod
+        self._diag_out = diag_out
+        self._profiler_owner = False
+        self.profiler = profiler_mod.process_profiler()
+        profile_hz = profiler_mod.resolve_hz()
+        if self.profiler is None and profile_hz > 0:
+            self.profiler = profiler_mod.SamplingProfiler(
+                hz=profile_hz).start()
+            profiler_mod.set_process_profiler(self.profiler)
+            self._profiler_owner = True
+        # A firing page-severity alert auto-attaches a profile tail
+        # next to the diagnostic bundles: the page says "too slow",
+        # the artifact says where the time was going when it fired.
+        self.slo.manager.on_fire = self._profile_on_page
 
     # UnixStreamServer's client_address is a path; BaseHTTPRequestHandler
     # wants a (host, port) tuple for logging.
@@ -1592,10 +1631,59 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             # cheap signal the fleet poll captures for `top`'s ALERTS
             # column. Full rows live on GET /alerts.
             "alerts": self.slo.manager.digest(),
+            # Continuous-profiling vitals: the sampler's own health
+            # (rate, sample/drop totals, self-measured overhead
+            # fraction against the 2% budget). Stacks live on
+            # GET /profile.
+            "profiler": self.profiler_health(),
         }
+
+    def profiler_health(self) -> dict:
+        if self.profiler is None:
+            return {"enabled": False, "hz": 0.0, "samples_total": 0,
+                    "dropped": 0, "throttled": 0, "distinct_stacks": 0,
+                    "overhead_fraction": 0.0}
+        return self.profiler.stats()
+
+    def profile(self, seconds: float) -> dict:
+        """The ``GET /profile?seconds=N`` body: a capture window from
+        the resident sampler, or — when profiling is disabled process-
+        wide — a temporary sampler spun up just for the window (the
+        on-demand path must work precisely on the deployments that
+        turned the always-on one off)."""
+        from makisu_tpu.utils import profiler as profiler_mod
+        seconds = min(max(float(seconds), 0.1), 30.0)
+        if self.profiler is not None and self.profiler.enabled:
+            return self.profiler.window(seconds, command="worker")
+        temp = profiler_mod.SamplingProfiler().start()
+        try:
+            temp._stop.wait(seconds)
+        finally:
+            temp.stop()
+        return temp.snapshot(command="worker")
+
+    def _profile_on_page(self, payload: dict) -> None:
+        """AlertManager ``on_fire`` hook: a page-severity alert writes
+        the sampler's current snapshot beside the diagnostic bundles,
+        named after the rule that fired."""
+        from makisu_tpu.utils import flightrecorder
+        from makisu_tpu.utils import profiler as profiler_mod
+        sampler = self.profiler
+        if sampler is None or not sampler.samples_total:
+            return
+        rule = str(payload.get("rule", "page")).replace("/", "_")
+        profiler_mod.write_artifact(
+            flightrecorder.forced_profile_path(
+                self._diag_out, f"alert-{rule}"),
+            sampler.snapshot(command=f"alert-{rule}"))
 
     def server_close(self) -> None:
         from makisu_tpu.utils import events
+        from makisu_tpu.utils import profiler as profiler_mod
+        if self._profiler_owner and self.profiler is not None:
+            self.profiler.stop()
+            if profiler_mod.process_profiler() is self.profiler:
+                profiler_mod.set_process_profiler(None)
         self.slo.stop()
         self._scrub_stop.set()
         if self._watchdog is not None:
