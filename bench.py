@@ -1209,6 +1209,142 @@ def _serve_micro() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _storage_soak_micro() -> dict:
+    """Content-store micro-section: a budgeted storage under a short
+    edited-rebuild soak. Reports three round-over-round numbers for
+    the eviction plane: (1) the steady-state disk high-water under a
+    tiny byte budget (early peak vs late peak — growth means the
+    evictor is losing); (2) the eviction-induced warm-rebuild latency
+    delta — a 1-edit rebuild after a full demotion pass, where the
+    chunks the rebuild dedups against live in the pack tier and must
+    refetch, measured against the resident 1-edit floor; (3) the
+    refetch share — bytes pulled back through the tier machinery as a
+    fraction of bytes evicted (a high share means the policy evicts
+    what builds still need). Digest identity of the post-eviction
+    rebuild is asserted against a session-less cold oracle. Pure CPU,
+    a few seconds. MAKISU_BENCH_STORAGE=0 skips the section."""
+    import random
+    import shutil
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from makisu_tpu.docker.image import ImageName
+    from makisu_tpu.storage import ImageStore, contentstore
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    files = int(os.environ.get("MAKISU_BENCH_STORAGE_FILES",
+                               "200") or 200)
+    file_kb = int(os.environ.get("MAKISU_BENCH_STORAGE_FILE_KB",
+                                 "4") or 4)
+    rounds = int(os.environ.get("MAKISU_BENCH_STORAGE_ROUNDS",
+                                "4") or 4)
+    tmp = tempfile.mkdtemp(prefix="bench-storage-soak-")
+    storage = os.path.join(tmp, "storage")
+    server = None
+    try:
+        ctx = os.path.join(tmp, "ctx")
+        src = os.path.join(ctx, "src")
+        os.makedirs(src)
+        rnd = random.Random(41)
+        for i in range(files):
+            with open(os.path.join(src, f"f{i}.bin"), "wb") as f:
+                f.write(rnd.randbytes(file_kb * 1024))
+        with open(os.path.join(ctx, "Dockerfile"), "w") as f:
+            f.write("FROM scratch\nCOPY src/ /src/\n")
+        root = os.path.join(tmp, "root")
+        os.makedirs(root)
+        server = WorkerServer(os.path.join(tmp, "worker.sock"))
+        server.serve_background()
+        client = WorkerClient(server.socket_path)
+
+        def build(tag: str, store_dir: str = "") -> float:
+            t0 = time.perf_counter()
+            code = client.build([
+                "--log-level", "error",
+                "build", ctx, "-t", tag, "--hasher", "tpu",
+                "--storage", store_dir or storage, "--root", root])
+            if code != 0:
+                raise RuntimeError(f"storage soak build exited {code}")
+            return time.perf_counter() - t0
+
+        def digests(tag: str, store_dir: str = "") -> list:
+            with ImageStore(store_dir or storage) as store:
+                manifest = store.manifests.load(ImageName.parse(tag))
+                return [l.digest.hex() for l in manifest.layers]
+
+        def edit(seed: int) -> None:
+            rnd2 = random.Random(seed)
+            i = rnd2.randrange(files)
+            with open(os.path.join(src, f"f{i}.bin"), "wb") as f:
+                f.write(rnd2.randbytes(file_kb * 1024))
+
+        cstore = contentstore.store_for(storage)
+        build("soak/st:cold")
+        build("soak/st:warm0")
+        edit(seed=3)
+        floor_s = build("soak/st:e1")  # resident 1-edit floor
+
+        # Full demotion pass: everything unpinned leaves the hot
+        # tier; the next 1-edit rebuild dedups against the pack tier.
+        c0 = contentstore.counters()
+        evict_pass = cstore.evict(budget_bytes=1)
+        edit(seed=5)
+        evicted_s = build("soak/st:e1-evicted")
+        c1 = contentstore.counters()
+        old_session = os.environ.get("MAKISU_TPU_SESSION")
+        os.environ["MAKISU_TPU_SESSION"] = "0"
+        try:
+            build("soak/st:oracle", os.path.join(tmp, "oracle"))
+        finally:
+            if old_session is None:
+                os.environ.pop("MAKISU_TPU_SESSION", None)
+            else:
+                os.environ["MAKISU_TPU_SESSION"] = old_session
+        identical = (digests("soak/st:e1-evicted")
+                     == digests("soak/st:oracle",
+                                os.path.join(tmp, "oracle")))
+
+        # Steady-state soak at a tiny budget: edits + rebuilds, one
+        # eviction pass per round, high-water sampled after each.
+        budget = max(16 << 10, (files * file_kb << 10) // 3)
+        highs = []
+        for r in range(rounds):
+            edit(seed=100 + r)
+            build(f"soak/st:r{r}")
+            cstore.evict(budget_bytes=budget)
+            highs.append(cstore.tier_bytes(publish=False)["hot"])
+        half = max(1, len(highs) // 2)
+        evicted_bytes = int(c1["evicted_bytes"] - c0["evicted_bytes"])
+        refetch_bytes = int(c1["refetch_bytes"] - c0["refetch_bytes"])
+        return {
+            "files": files,
+            "file_kb": file_kb,
+            "floor_1edit_seconds": round(floor_s, 3),
+            "evicted_1edit_seconds": round(evicted_s, 3),
+            "evicted_rebuild_delta_seconds": round(
+                evicted_s - floor_s, 3),
+            "digest_identity": identical,
+            "demotion_evicted": int(evict_pass.get("evicted", 0)),
+            "evicted_bytes": evicted_bytes,
+            "refetch_bytes": refetch_bytes,
+            "refetch_share": round(refetch_bytes / evicted_bytes, 4)
+            if evicted_bytes else 0.0,
+            "soak_budget_bytes": budget,
+            "soak_rounds": rounds,
+            "high_water_early_bytes": max(highs[:half]) if highs
+            else 0,
+            "high_water_late_bytes": max(highs[half:])
+            if highs[half:] else 0,
+            "high_water_steady": bool(highs) and max(
+                highs[half:] or highs) <= max(highs[:half]) * 1.25,
+        }
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _cache_explain_round() -> dict:
     """Cache-attribution micro-round: build a small context cold, warm,
     then once more with one edited file — through the real CLI with
@@ -1781,6 +1917,15 @@ def main() -> int:
             record["serve"] = _serve_micro()
     except Exception as e:  # noqa: BLE001 - informational section
         record["serve"] = {"error": str(e)[:200]}
+    # Content-store micro-section: steady-state disk high-water under
+    # a byte budget, the eviction-induced warm-rebuild latency delta
+    # vs the resident floor, and the refetch share of evicted bytes —
+    # the eviction plane's round-over-round numbers.
+    try:
+        if os.environ.get("MAKISU_BENCH_STORAGE", "1") == "1":
+            record["storage_soak"] = _storage_soak_micro()
+    except Exception as e:  # noqa: BLE001 - informational section
+        record["storage_soak"] = {"error": str(e)[:200]}
     # Cache-attribution micro-round: the ledger summary (dedup ratio,
     # bytes refetched, flipped nodes on a 1-file edit) rides in the
     # record, and the full ledgers/explain text land as artifacts in

@@ -740,6 +740,24 @@ class StorageCensus:
         blob_names = {n for n, _, _ in blob_rows}
         zpack_rows = self._walk_flat(self.zpacks_dir, ".zst")
 
+        # Demotion-aware reference check: a chunk absent from the CAS
+        # whose pack survives as a compressed twin (or on the remote
+        # tier) is DEMOTED, not dangling — the bytes are one local
+        # decompress away and ensure_available promotes them back.
+        # Only a missing chunk with no recoverable pack is an error.
+        from makisu_tpu.storage import contentstore
+        _cstore = contentstore.store_for(self.storage_dir)
+        _recoverable: dict[str, bool] = {}
+
+        def pack_recoverable(pack_hex: str) -> bool:
+            ok = _recoverable.get(pack_hex)
+            if ok is None:
+                ok = _recoverable[pack_hex] = \
+                    _cstore.pack_recoverable(pack_hex)
+            return ok
+
+        demoted_chunks: set[str] = set()
+
         itemized: dict[str, int] = {}
 
         def add(kind: str, severity: str, plane: str, detail: str,
@@ -771,6 +789,9 @@ class StorageCensus:
                         and ("chunk", layer_hex, fp)
                         not in seen_edges):
                     seen_edges.add(("chunk", layer_hex, fp))
+                    if pack_recoverable(pack_hex):
+                        demoted_chunks.add(fp)
+                        continue
                     dangling_recipes.add(layer_hex)
                     add("dangling_chunk", "error", "recipes",
                         f"recipe {layer_hex[:12]} references chunk "
@@ -794,6 +815,9 @@ class StorageCensus:
             for fp, _ in members:
                 referenced_chunks.add(fp)
                 if fp not in chunk_names:
+                    if pack_recoverable(pack_hex):
+                        demoted_chunks.add(fp)
+                        continue
                     dangling_tables.add(pack_hex)
                     add("dangling_pack_member", "error", "packs",
                         f"pack {pack_hex[:12]} references evicted "
@@ -905,6 +929,9 @@ class StorageCensus:
                 "orphaned_bytes": sum(chunk_sizes[n]
                                       for n in orphan_chunks),
                 "dangling": 0,
+                # Referenced, absent from the CAS, recoverable from a
+                # pack tier — the budget evictor's expected footprint.
+                "demoted": len(demoted_chunks),
             },
             "blobs": {
                 "live": len(live_blobs),
@@ -980,13 +1007,16 @@ class StorageCensus:
     def eviction_dry_run(self, budget_bytes: int,
                          seed_state: dict | None = None,
                          max_itemized: int = 50) -> dict:
-        """What an LRU policy at byte budget N *would* evict from the
-        CAS planes (chunks + blobs; packs and recipes follow their
-        referents' lifecycle, they are not independent LRU victims).
-        Recency is file mtime — the same seed the live store's LRU
-        uses across restarts. Refuses when a live chunk CAS reports
-        its mtime seed is still running: a dry-run over partial
-        recency data names the wrong victims."""
+        """What the eviction policy at byte budget N *would* evict
+        from the CAS planes (chunks + blobs; packs and recipes follow
+        their referents' lifecycle, they are not independent LRU
+        victims). This is a DRY-RUN OF THE REAL EVICTOR, not a
+        parallel estimate: rows, protected set, and victim order all
+        come from storage/contentstore.py's one ``EvictionPolicy`` —
+        the same objects a live ``ContentStore.evict`` would name.
+        Refuses when a live chunk CAS reports its mtime seed is still
+        running: a dry-run over partial recency data names the wrong
+        victims."""
         if seed_state and seed_state.get("state") != "seeded":
             return {
                 "refused": True,
@@ -996,35 +1026,11 @@ class StorageCensus:
                 "seed": dict(seed_state),
                 "budget_bytes": int(budget_bytes),
             }
-        rows: list[tuple[float, int, str, str]] = []
-        for name, size, mtime in self._walk_cas(self.chunks_dir):
-            rows.append((mtime, size, "chunks", name))
-        for name, size, mtime in self._walk_cas(self.layers_dir):
-            rows.append((mtime, size, "blobs", name))
-        current = sum(size for _, size, _, _ in rows)
-        rows.sort()  # oldest mtime first = LRU victims first
-        freed = 0
-        victims: list[dict] = []
-        evict_count = 0
-        now = time.time()
-        for mtime, size, plane, name in rows:
-            if current - freed <= budget_bytes:
-                break
-            freed += size
-            evict_count += 1
-            if len(victims) < max_itemized:
-                victims.append({
-                    "plane": plane, "object": name, "bytes": size,
-                    "age_seconds": round(max(0.0, now - mtime), 1)})
-        return {
-            "refused": False,
-            "budget_bytes": int(budget_bytes),
-            "current_bytes": current,
-            "evict_count": evict_count,
-            "freed_bytes": freed,
-            "remaining_bytes": current - freed,
-            "would_evict": victims,
-        }
+        from makisu_tpu.storage import contentstore
+        rows = contentstore.collect_rows(self.storage_dir)
+        policy = contentstore.policy_for(self.storage_dir)
+        return policy.plan(rows, int(budget_bytes),
+                           max_itemized=max_itemized)
 
     # -- integrity scrub --------------------------------------------------
 
@@ -1277,6 +1283,15 @@ def render_storage_doctor(entries: list[dict], target: str) -> str:
                     f"  eviction dry-run: REFUSED — "
                     f"{dry.get('reason', '')}")
             else:
+                actions = dry.get("actions") or {}
+                tail = ""
+                if actions.get("demote"):
+                    tail += (f", {actions['demote']} demote to "
+                             f"pack tier")
+                if dry.get("pinned_skipped"):
+                    tail += (f"; {dry['pinned_skipped']} pinned "
+                             f"object(s) protected ("
+                             f"{traceexport.fmt_bytes(dry.get('pinned_bytes', 0))})")
                 lines.append(
                     f"  eviction dry-run @ "
                     f"{traceexport.fmt_bytes(dry.get('budget_bytes', 0))}: "
@@ -1284,7 +1299,23 @@ def render_storage_doctor(entries: list[dict], target: str) -> str:
                     f"free "
                     f"{traceexport.fmt_bytes(dry.get('freed_bytes', 0))} "
                     f"(current "
-                    f"{traceexport.fmt_bytes(dry.get('current_bytes', 0))})")
+                    f"{traceexport.fmt_bytes(dry.get('current_bytes', 0))})"
+                    f"{tail}")
+        cstore = entry.get("contentstore")
+        if cstore:
+            tiers = cstore.get("tiers") or {}
+            budget = int(cstore.get("budget_bytes", 0) or 0)
+            lines.append(
+                f"  content store: budget "
+                f"{traceexport.fmt_bytes(budget) if budget else 'unbounded'}"
+                f", tiers hot="
+                f"{traceexport.fmt_bytes(tiers.get('hot', 0))} "
+                f"pack={traceexport.fmt_bytes(tiers.get('pack', 0))} "
+                f"remote="
+                f"{traceexport.fmt_bytes(tiers.get('remote', 0))}, "
+                f"{cstore.get('pins', 0)} live pin(s), "
+                f"{cstore.get('snapshot_pinned_chunks', 0)} "
+                f"snapshot-pinned chunk(s)")
         repair = entry.get("repair")
         if repair:
             verb = ("deleted" if repair.get("applied")

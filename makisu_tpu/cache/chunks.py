@@ -240,6 +240,13 @@ class ChunkStore:
             except ValueError:
                 max_entries = 1 << 20  # cache sizing never fails builds
         self.cas = CASStore(root, max_entries)
+        # Refcount plane: reads pin their chunk for their duration, the
+        # budget evictor and the CAS's own count-LRU both honor pins
+        # (storage/contentstore.py keys the board by storage dir, so
+        # the worker's serve plane and this store share one board).
+        from makisu_tpu.storage import contentstore
+        self.pins = contentstore.board_for_chunk_root(root)
+        self.cas.pin_check = self.pins.chunk_pinned
         self.registry = None  # attach via set_remote()
         # Fingerprint-streamed existence memo (note_fingerprint): the
         # commit pipeline reports each chunk digest as it is hashed,
@@ -280,6 +287,14 @@ class ChunkStore:
 
     def has(self, hex_digest: str) -> bool:
         if self.cas.exists(hex_digest):
+            return True
+        # A demoted chunk promotes back from its pack's compressed
+        # twin before the registry is asked (local decompress beats a
+        # WAN round trip; also the only route when no registry is
+        # attached — the worker's serve path after budget eviction).
+        from makisu_tpu.storage import contentstore
+        if contentstore.refetch_for_chunk_root(
+                self.cas.root, [hex_digest], {}, put=self.put):
             return True
         if self.registry is not None:
             return self._fetch_remote(hex_digest)
@@ -448,8 +463,11 @@ class ChunkStore:
         return self.cas.exists(hex_digest)
 
     def get(self, hex_digest: str) -> bytes:
-        with self.cas.open(hex_digest) as f:
-            return f.read()
+        # Pin across the open+read: a concurrent eviction pass may cut
+        # its victim list any time, and this read must win.
+        with self.pins.pinned("chunks", hex_digest):
+            with self.cas.open(hex_digest) as f:
+                return f.read()
 
     def put(self, hex_digest: str, data: bytes) -> None:
         if hashlib.sha256(data).hexdigest() != hex_digest:
@@ -625,7 +643,18 @@ class ChunkStore:
 
         if not missing:
             return outcome(True)
-        # Peer exchange first: a fleet sibling that built this (or any
+        # Tier refetch first: a chunk the budget evictor demoted is
+        # still on disk (or one object-tier read away) in its pack's
+        # compressed twin — promoting it back is a local decompress,
+        # cheaper than any wire route. No serve plane: free no-op.
+        from makisu_tpu.storage import contentstore
+        restored = contentstore.refetch_for_chunk_root(
+            self.cas.root, missing, lengths, put=self.put)
+        if restored:
+            missing = [h for h in missing if h not in restored]
+            if not missing:
+                return outcome(True)
+        # Peer exchange next: a fleet sibling that built this (or any
         # chunk-sharing) context holds the bytes one unix-socket round
         # trip away — the registry is a WAN away and the KV blob plane
         # may not even be attached. Budget-charged through the transfer
@@ -960,6 +989,20 @@ class ChunkStore:
                 self._fh = None
                 self._remaining = 0
                 self._pos = 0
+                self._pinned: str | None = None
+
+            def _pin(self, hex_digest: str | None) -> None:
+                # One pin held at a time, on the chunk currently being
+                # read: an eviction pass cutting its victim list while
+                # this stream walks a layer must not delete the chunk
+                # under the open fd's NAME (the bytes would survive the
+                # unlink, but a later reader of the same stream plan
+                # would miss; the pin keeps plan and disk coherent).
+                if self._pinned is not None:
+                    store.pins.unpin("chunks", self._pinned)
+                self._pinned = hex_digest
+                if hex_digest is not None:
+                    store.pins.pin("chunks", hex_digest)
 
             def _advance(self) -> bool:
                 while self._idx < len(self._chunks):
@@ -971,6 +1014,7 @@ class ChunkStore:
                             f"(expected {self._pos})")
                     if length == 0:
                         continue
+                    self._pin(hex_digest)
                     # Open directly; a local miss falls back to the
                     # remote probe. An 800MB layer is ~100k chunks, so
                     # this path runs ~100k times — the happy path must
@@ -979,12 +1023,14 @@ class ChunkStore:
                         self._fh = store.cas.open(hex_digest)
                     except FileNotFoundError:
                         if not store.has(hex_digest):
+                            self._pin(None)
                             raise FileNotFoundError(
                                 f"chunk {hex_digest} unavailable"
                             ) from None
                         self._fh = store.cas.open(hex_digest)
                     self._remaining = length
                     return True
+                self._pin(None)
                 return False
 
             def read(self, n: int = -1) -> bytes:
@@ -1014,6 +1060,7 @@ class ChunkStore:
                 if self._fh is not None:
                     self._fh.close()
                     self._fh = None
+                self._pin(None)
 
             def __enter__(self):
                 return self

@@ -177,6 +177,21 @@ def make_parser() -> argparse.ArgumentParser:
     build.add_argument("--storage", default="",
                        help="storage directory (default /makisu-storage or "
                             "$HOME fallback)")
+    build.add_argument("--storage-budget", type=int, default=None,
+                       metavar="MB",
+                       help="hot-tier byte budget for the storage dir "
+                            "(chunks + blobs); past it, cold objects "
+                            "evict LRU after the build — chunks whose "
+                            "pack has a compressed twin demote (bytes "
+                            "recoverable locally), the rest refetch "
+                            "via peers/registry "
+                            "(MAKISU_TPU_STORAGE_BUDGET_MB; "
+                            "0/unset = unbounded)")
+    build.add_argument("--storage-remote", default=None,
+                       metavar="DIR",
+                       help="remote/object tier directory: cold packs "
+                            "demote there and refetch on demand "
+                            "(MAKISU_TPU_STORAGE_REMOTE)")
     build.add_argument("--compression", default="default",
                        choices=sorted(tario.COMPRESSION_LEVELS))
     build.add_argument("--gzip-backend", default="zlib",
@@ -267,6 +282,18 @@ def make_parser() -> argparse.ArgumentParser:
                              "transition here as JSON (bounded "
                              "timeout; failures counted, never "
                              "blocking)")
+    worker.add_argument("--storage-budget", type=int, default=None,
+                        metavar="MB",
+                        help="hot-tier byte budget per storage dir "
+                             "this worker builds against; enforced "
+                             "after each build and on the scrub "
+                             "cadence (MAKISU_TPU_STORAGE_BUDGET_MB; "
+                             "0/unset = unbounded)")
+    worker.add_argument("--storage-remote", default=None,
+                        metavar="DIR",
+                        help="remote/object tier directory for cold "
+                             "pack demotion "
+                             "(MAKISU_TPU_STORAGE_REMOTE)")
 
     serve = sub.add_parser(
         "serve", help="run a chunk-native distribution endpoint over "
@@ -477,6 +504,15 @@ def make_parser() -> argparse.ArgumentParser:
                          help="slo-smoke: write the alert transitions "
                               "(fired/resolved) as an alert-only "
                               "NDJSON file — the CI artifact")
+    loadgen.add_argument("--evict-soak", action="store_true",
+                         help="eviction soak scenario: the same "
+                              "edited-rebuild stream against a "
+                              "tiny-budget storage and an unbudgeted "
+                              "oracle; asserts evictions fire, disk "
+                              "high-water reaches steady state, "
+                              "every round's digests match the "
+                              "oracle byte for byte, and the "
+                              "post-soak scrub finds zero corruption")
     loadgen.add_argument("--prewarm-smoke", action="store_true",
                          help="session-snapshot recovery scenario: a "
                               "worker is killed (no teardown) after a "
@@ -710,6 +746,16 @@ def _new_cache_manager(args, store, registry_client=None):
 
 
 def cmd_build(args) -> int:
+    from makisu_tpu.storage import contentstore
+    storage_dir = _storage_dir(args.storage)
+    if getattr(args, "storage_budget", None) is not None:
+        # Per-dir override, not a process-global: a worker runs many
+        # builds against many dirs, and one build's flag must not
+        # rebudget its neighbors.
+        contentstore.set_budget_for(storage_dir,
+                                    max(0, args.storage_budget) << 20)
+    if getattr(args, "storage_remote", None) is not None:
+        contentstore.configure(remote=args.storage_remote)
     if getattr(args, "watch", False):
         if invocation_mode.get() == "worker":
             # A worker build runs on a handler thread; an endless
@@ -720,7 +766,11 @@ def cmd_build(args) -> int:
                         "worker itself is the resident process)")
         else:
             return _watch_loop(args)
-    return _build_once(args)
+    code = _build_once(args)
+    # Enforce the byte budget at the moment disk grew (throttled;
+    # no-op unbudgeted; never fails a finished build).
+    contentstore.store_for(storage_dir).maybe_evict()
+    return code
 
 
 def _watch_loop(args) -> int:
@@ -1359,6 +1409,9 @@ def _doctor_storage(args) -> int:
                  "census": census.census(),
                  "audit": census.audit(),
                  "scrub": census.scrub()}
+        from makisu_tpu.storage import contentstore
+        entry["contentstore"] = \
+            contentstore.store_for(storage_dir).describe()
         seed = census_mod.seed_states(storage_dir)
         if seed:
             entry["lru_seed"] = seed
@@ -1539,6 +1592,14 @@ def cmd_worker(args) -> int:
     from makisu_tpu.utils import flightrecorder
     from makisu_tpu.utils import metrics as metrics_mod
     from makisu_tpu.worker import WorkerServer
+    if args.storage_budget is not None or \
+            args.storage_remote is not None:
+        # Worker-wide defaults: every storage dir this worker builds
+        # against inherits them (a build's own --storage-budget flag
+        # still overrides per-dir).
+        from makisu_tpu.storage import contentstore
+        contentstore.configure(budget_mb=args.storage_budget,
+                               remote=args.storage_remote)
     server = WorkerServer(args.socket,
                           stall_window=(args.stall_timeout or
                                         None),

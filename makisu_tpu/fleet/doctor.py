@@ -195,6 +195,25 @@ def diagnose_fleet(health: dict,
                           f"entries seeded) — eviction dry-runs "
                           f"refuse until the seed completes",
             })
+        # Budget digest (PR 20's content store): a worker far over
+        # its hot-tier byte budget is one the evictor cannot keep up
+        # with — routing demotes it (pressure_demoted) and the disk
+        # will fill unless the budget, tiering, or load changes.
+        budget = storage.get("budget") or {}
+        pressure = float(budget.get("pressure", 0.0) or 0.0)
+        if pressure >= 1.25:
+            findings.append({
+                "severity": "warning",
+                "kind": "storage_pressure",
+                "worker": wid,
+                "detail": f"worker {wid}'s hot tier is at "
+                          f"{100.0 * pressure:.0f}% of its storage "
+                          f"budget ({budget.get('hot_bytes', 0)} of "
+                          f"{budget.get('budget_bytes', 0)} bytes; "
+                          f"{budget.get('evictions_total', 0)} "
+                          f"evictions so far) — routing demotes it "
+                          f"until eviction catches up",
+            })
     # 5a. Continuous-profiling vitals: each worker's /healthz carries
     # its sampler digest. A sampler past its overhead budget is
     # charging builds for its own observation; dropped stacks mean the

@@ -53,6 +53,12 @@ FLEET_RETRIES = metrics.FLEET_RETRIES
 # ring rebuilds are free.
 _VIRTUAL_NODES = 64
 
+# Hot-tier occupancy/budget ratio past which spillover/failover skip a
+# worker (pressure_demoted). 1.25 = 25% over budget: transiently over
+# is the evictor's normal operating point right after a build lands —
+# only a worker the evictor visibly cannot keep up with is demoted.
+STORAGE_PRESSURE_THRESHOLD = 1.25
+
 # Distinct tenants tracked with their own quota budget; overflow
 # tenants share one "other" budget (same cardinality discipline as the
 # worker's latency rings).
@@ -192,6 +198,18 @@ class WorkerState:
     def eligible(self) -> bool:
         return self.alive and not self.draining
 
+    @property
+    def storage_pressure(self) -> float:
+        """Hot-tier occupancy over budget from the worker's /healthz
+        storage digest (0.0 when unbudgeted/unknown). Routing demotes
+        a worker whose disk is far past its budget — its next build
+        pays eviction churn and refetch latency."""
+        try:
+            budget = self.storage.get("budget") or {}
+            return float(budget.get("pressure", 0.0) or 0.0)
+        except (TypeError, ValueError, AttributeError):
+            return 0.0
+
     def load(self) -> int:
         """Routing load score: what's queued there plus what we have
         in flight ourselves."""
@@ -218,6 +236,7 @@ class WorkerState:
             "builds_succeeded": self.builds_succeeded,
             "builds_failed": self.builds_failed,
             "health_score": round(self.health_score, 4),
+            "storage_pressure": round(self.storage_pressure, 4),
             "alerts": dict(self.alerts),
             "profiler": dict(self.profiler),
             "routed_total": self.routed_total,
@@ -275,6 +294,12 @@ class FleetScheduler:
         # a flaky canary, and demotion must not shed the warm state
         # that makes the worker worth routing to once it recovers.
         self.health_page_threshold = float(health_page_threshold)
+        # Disk-pressure demotion threshold: hot-tier bytes over budget
+        # past which a worker is skipped by spillover/failover (its
+        # next build pays eviction churn while a sibling has headroom).
+        # 1.0 is "exactly at budget" — demote only meaningfully past
+        # it; affinity still wins for the same reason as health.
+        self.storage_pressure_threshold = STORAGE_PRESSURE_THRESHOLD
         self._mu = threading.Lock()
         self.workers: dict[str, WorkerState] = {
             spec.id: WorkerState(spec) for spec in specs}
@@ -520,6 +545,7 @@ class FleetScheduler:
                         chosen = candidates[memo]
                         verdict, reason = "affinity", "sticky"
             demoted: list[tuple[str, float]] = []
+            pressure_demoted: list[tuple[str, float]] = []
             pool = candidates
             if chosen is None:
                 # Health demotion (spillover/failover only — a worker
@@ -537,6 +563,19 @@ class FleetScheduler:
                         for wid, w in candidates.items()
                         if wid not in healthy)
                     pool = healthy
+                # Disk-pressure demotion, same never-strand shape:
+                # skip workers far over their storage budget while
+                # any peer with headroom remains.
+                unpressured = {
+                    wid: w for wid, w in pool.items()
+                    if w.storage_pressure
+                    < self.storage_pressure_threshold}
+                if unpressured and len(unpressured) < len(pool):
+                    pressure_demoted = sorted(
+                        (wid, w.storage_pressure)
+                        for wid, w in pool.items()
+                        if wid not in unpressured)
+                    pool = unpressured
             if chosen is None and context_key:
                 # 2. Consistent-hash placement for new contexts.
                 owner_id = self._ring_owner(context_key,
@@ -568,6 +607,12 @@ class FleetScheduler:
                 reason="canary_health", tenant=tenant, worker=wid,
                 score=round(score, 4),
                 threshold=self.health_page_threshold)
+        for wid, pressure in pressure_demoted:
+            self._record_decision(
+                context_key or "<no-context>", "pressure_demoted",
+                reason="storage_pressure", tenant=tenant, worker=wid,
+                pressure=round(pressure, 4),
+                threshold=self.storage_pressure_threshold)
         self._record_decision(context_key or "<no-context>", verdict,
                               reason=reason, tenant=tenant,
                               worker=chosen.spec.id, attempt=attempt)

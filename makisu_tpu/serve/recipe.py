@@ -597,6 +597,8 @@ class RecipeStore:
         if members is None:
             raise FileNotFoundError(pack_hex)
         from makisu_tpu.cache import chunks as chunks_mod
+        from makisu_tpu.storage import contentstore
+        board = contentstore.board_for_chunk_root(self.chunk_root)
         off = 0
         for fp, length in members:
             if off + length <= start:
@@ -606,20 +608,32 @@ class RecipeStore:
                 return
             lo = max(start - off, 0)
             hi = min(end - off, length)
-            fh = chunks_mod.open_served_chunk(
-                fp, roots={self.chunk_root})
-            if fh is None:
-                raise FileNotFoundError(fp)
-            with fh:
-                if lo:
-                    fh.seek(lo)
-                remaining = hi - lo
-                while remaining > 0:
-                    piece = fh.read(min(remaining, piece_size))
-                    if not piece:
-                        raise ValueError(
-                            f"chunk {fp} shorter than its recorded "
-                            f"length")
-                    remaining -= len(piece)
-                    yield piece
+            # Pin across the member read: a peer-serve range in flight
+            # must never lose its chunk to a budget eviction pass.
+            with board.pinned("chunks", fp):
+                fh = chunks_mod.open_served_chunk(
+                    fp, roots={self.chunk_root})
+                if fh is None:
+                    # The member may have been demoted to a pack tier
+                    # by a budget eviction pass; try a refetch before
+                    # giving up on the range.
+                    restored = contentstore.refetch_for_chunk_root(
+                        self.chunk_root, [fp], {fp: length})
+                    if fp in restored:
+                        fh = chunks_mod.open_served_chunk(
+                            fp, roots={self.chunk_root})
+                if fh is None:
+                    raise FileNotFoundError(fp)
+                with fh:
+                    if lo:
+                        fh.seek(lo)
+                    remaining = hi - lo
+                    while remaining > 0:
+                        piece = fh.read(min(remaining, piece_size))
+                        if not piece:
+                            raise ValueError(
+                                f"chunk {fp} shorter than its "
+                                f"recorded length")
+                        remaining -= len(piece)
+                        yield piece
             off += length

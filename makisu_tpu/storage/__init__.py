@@ -11,7 +11,11 @@ FileState/FileOp machinery while keeping the same observable operations
 """
 
 from makisu_tpu.storage.cas import CASStore
+from makisu_tpu.storage.contentstore import (ContentStore,
+                                             EvictionPolicy, PinBoard,
+                                             store_for)
 from makisu_tpu.storage.image_store import ImageStore
 from makisu_tpu.storage.manifests import ManifestStore
 
-__all__ = ["CASStore", "ImageStore", "ManifestStore"]
+__all__ = ["CASStore", "ContentStore", "EvictionPolicy", "ImageStore",
+           "ManifestStore", "PinBoard", "store_for"]

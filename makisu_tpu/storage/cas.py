@@ -43,6 +43,11 @@ class CASStore:
         self.max_entries = max_entries
         self._lock = threading.Lock()
         self._last_access: dict[str, float] = {}
+        # Optional pin predicate (name -> bool). A True answer keeps
+        # the entry out of count-LRU victim selection — the content
+        # store's refcount plane wires this so an in-flight read can
+        # never lose its chunk to the entry-count cap either.
+        self.pin_check = None
         os.makedirs(root, exist_ok=True)
         self._tmp_dir = os.path.join(root, "_tmp")
         os.makedirs(self._tmp_dir, exist_ok=True)
@@ -236,11 +241,21 @@ class CASStore:
             return
         if len(self._last_access) <= self.max_entries:
             return
+        pool = self._last_access
+        if self.pin_check is not None:
+            try:
+                pool = {name: ts for name, ts in
+                        self._last_access.items()
+                        if not self.pin_check(name)}
+            # Pins advise; a broken pin_check must never block eviction,
+            # so fall back to the full pool.  # check: allow(silent-swallow)
+            except Exception:  # noqa: BLE001
+                pool = self._last_access
         excess = len(self._last_access) - self.max_entries
         batch = excess if self.max_entries < 4096 else max(
             excess, self.max_entries // 10)
-        victims = heapq.nsmallest(batch, self._last_access,
-                                  key=self._last_access.get)
+        batch = min(batch, len(pool))
+        victims = heapq.nsmallest(batch, pool, key=pool.get)
         for victim in victims:
             p = self._path(victim)
             if os.path.isfile(p):

@@ -192,6 +192,8 @@ class _Sampler(threading.Thread):
 
 
 def run(args) -> int:
+    if getattr(args, "evict_soak", False):
+        return _run_evict_soak(args)
     if getattr(args, "prewarm_smoke", False):
         return _run_prewarm_smoke(args)
     if getattr(args, "slo_smoke", False):
@@ -1503,6 +1505,211 @@ def render_prewarm_smoke(report: dict) -> str:
             f"snapshotted {drain.get('sessions_snapshotted', 0)}, "
             f"prewarm decisions {drain.get('prewarm_decisions', 0)}, "
             f"mode {drain.get('mode', '?')}")
+    return "\n".join(lines) + "\n"
+
+
+def _run_evict_soak(args) -> int:
+    """The content store's acceptance scenario: the SAME edited-
+    rebuild stream runs against two storages — one carrying a tiny
+    byte budget (the subject, evicting every build) and one
+    unbudgeted (the oracle). Gates:
+
+    - evictions actually fired on the subject
+      (``makisu_storage_evictions_total`` delta > 0);
+    - the subject's disk high-water reaches steady state — the later
+      rounds' peak stays within 25% of the earlier rounds' peak
+      instead of growing monotonically like the oracle's;
+    - every round's layer digests are byte-identical to the
+      unbudgeted oracle's (eviction never changes build output);
+    - a post-soak integrity scrub over the evicted store reports
+      ZERO corruption findings, and the audit reports zero errors.
+
+    Exit code is nonzero when any gate fails."""
+    from makisu_tpu.cache import census as census_mod
+    from makisu_tpu.storage import contentstore
+    from makisu_tpu.worker import WorkerClient, WorkerServer
+
+    work_dir = args.work_dir or tempfile.mkdtemp(
+        prefix="makisu-evict-soak-")
+    os.makedirs(work_dir, exist_ok=True)
+    cleanup_work = not args.work_dir
+
+    rounds = args.rounds if args.rounds >= 4 else 6
+    subject = os.path.join(work_dir, "subject-storage")
+    oracle = os.path.join(work_dir, "oracle-storage")
+    ctx = os.path.join(work_dir, "soak-ctx")
+    _make_template(ctx, 0, args.files, args.file_kb)
+    root = os.path.join(work_dir, "soak-root")
+    os.makedirs(root, exist_ok=True)
+    hist = os.path.join(work_dir, "soak-history.jsonl")
+
+    # Tiny budget: about a third of one context's source bytes, so a
+    # couple of rounds of churn overflow it and the evictor must hold
+    # the line for the rest of the soak.
+    budget_bytes = max(16 << 10,
+                       (args.files * args.file_kb << 10) // 3)
+    contentstore.set_budget_for(subject, budget_bytes)
+    # A remote tier for the subject so cold packs always have
+    # somewhere to demote — even on a libzstd-less host where no
+    # compressed twins exist (the raw-pack demotion path).
+    prev_remote = contentstore.remote_tier_dir()
+    contentstore.configure(
+        remote=os.path.join(work_dir, "remote-tier"))
+    prev_evict_env = os.environ.get("MAKISU_TPU_STORAGE_EVICT_SECONDS")
+    os.environ["MAKISU_TPU_STORAGE_EVICT_SECONDS"] = "0"
+
+    gates: dict[str, bool] = {}
+    soak: dict = {"rounds": [], "budget_bytes": budget_bytes}
+    counters0 = contentstore.counters()
+    server = WorkerServer(
+        os.path.join(work_dir, "soak.sock"),
+        max_concurrent_builds=args.max_concurrent_builds)
+    server.serve_background()
+
+    def build(storage: str, tag: str) -> int:
+        client = WorkerClient(server.socket_path)
+        argv = ["--log-level", "error", "--history-out", hist,
+                "build", ctx, "-t", tag, "--hasher", args.hasher,
+                "--root", root, "--storage", storage]
+        reg_token = metrics.set_build_registry(
+            metrics.MetricsRegistry())
+        try:
+            return client.build(argv, tenant="default")
+        except (OSError, RuntimeError,
+                http.client.HTTPException) as e:
+            log.error("evict-soak build %s failed to submit: %s",
+                      tag, e)
+            return -1
+        finally:
+            metrics.reset_build_registry(reg_token)
+
+    def hot_bytes(storage: str) -> int:
+        return contentstore.store_for(storage).tier_bytes(
+            publish=False)["hot"]
+
+    try:
+        client = WorkerClient(server.socket_path)
+        deadline = time.monotonic() + args.ready_timeout
+        while not client.ready():
+            if time.monotonic() >= deadline:
+                log.error("evict-soak: worker never became ready")
+                return 1
+            time.sleep(0.05)
+
+        codes_ok = True
+        digests_ok = True
+        for r in range(rounds):
+            if r:
+                _edit_files(ctx, args.edit_churn, f"round-{r}")
+            tag = f"soak/ctx:r{r}"
+            sc = build(subject, tag)
+            s_digests = _layer_digests(subject, tag) if sc == 0 else []
+            oc = build(oracle, tag)
+            o_digests = _layer_digests(oracle, tag) if oc == 0 else []
+            codes_ok = codes_ok and sc == 0 and oc == 0
+            digests_ok = digests_ok and bool(o_digests) \
+                and s_digests == o_digests
+            soak["rounds"].append({
+                "round": r,
+                "subject_exit": sc,
+                "oracle_exit": oc,
+                "digests_match": bool(o_digests)
+                and s_digests == o_digests,
+                "subject_hot_bytes": hot_bytes(subject),
+                "oracle_hot_bytes": hot_bytes(oracle),
+            })
+    finally:
+        server.shutdown()
+        server.server_close()
+        contentstore.configure(remote=prev_remote or "")
+        if prev_evict_env is None:
+            os.environ.pop("MAKISU_TPU_STORAGE_EVICT_SECONDS", None)
+        else:
+            os.environ["MAKISU_TPU_STORAGE_EVICT_SECONDS"] = \
+                prev_evict_env
+
+    counters1 = contentstore.counters()
+    evictions = int(counters1["evictions"] - counters0["evictions"])
+    highs = [row["subject_hot_bytes"] for row in soak["rounds"]]
+    half = max(1, len(highs) // 2)
+    early_peak = max(highs[:half]) if highs else 0
+    late_peak = max(highs[half:]) if highs[half:] else 0
+    subject_census = census_mod.StorageCensus(subject)
+    audit = subject_census.audit()
+    scrub = subject_census.scrub(chunk_samples=64, pack_samples=4)
+    audit_errors = [f for f in audit.get("findings", [])
+                    if f.get("severity") == "error"]
+
+    gates["builds_succeeded"] = codes_ok
+    gates["evictions_fired"] = evictions > 0
+    gates["high_water_steady"] = early_peak > 0 \
+        and late_peak <= early_peak * 1.25
+    gates["digests_match_oracle"] = digests_ok
+    gates["scrub_clean"] = not scrub.get("findings")
+    gates["audit_clean"] = not audit_errors
+
+    soak["gates"] = gates
+    soak["evictions"] = evictions
+    soak["evicted_bytes"] = int(
+        counters1["evicted_bytes"] - counters0["evicted_bytes"])
+    soak["refetch_bytes"] = int(
+        counters1["refetch_bytes"] - counters0["refetch_bytes"])
+    soak["early_peak_bytes"] = early_peak
+    soak["late_peak_bytes"] = late_peak
+    soak["oracle_final_bytes"] = \
+        soak["rounds"][-1]["oracle_hot_bytes"] if soak["rounds"] else 0
+    soak["scrub"] = {k: scrub[k] for k in
+                     ("chunks_checked", "packs_checked")
+                     if k in scrub}
+    soak["scrub"]["findings"] = len(scrub.get("findings", []))
+    soak["audit_errors"] = len(audit_errors)
+    soak["contentstore"] = contentstore.store_for(subject).describe()
+
+    report = {
+        "schema": LOADGEN_SCHEMA,
+        "mode": "evict-soak",
+        "config": {
+            "rounds": rounds,
+            "files": args.files,
+            "file_kb": args.file_kb,
+            "edit_churn": args.edit_churn,
+            "budget_bytes": budget_bytes,
+            "hasher": args.hasher,
+        },
+        "evict_soak": soak,
+        "ok": bool(gates) and all(gates.values()),
+    }
+    if args.report:
+        metrics.write_json_atomic(args.report, report)
+        log.info("evict-soak report written to %s", args.report)
+    print(render_evict_soak(report), end="")
+    if cleanup_work:
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return 0 if report["ok"] else 1
+
+
+def render_evict_soak(report: dict) -> str:
+    """Human digest of an eviction soak: gates, then the disk
+    high-water trajectory and eviction/refetch volumes they gated."""
+    soak = report.get("evict_soak", {})
+    gates = soak.get("gates", {})
+    lines = [
+        f"evict-soak: {'PASS' if report.get('ok') else 'FAIL'} "
+        f"({sum(1 for v in gates.values() if v)}/{len(gates)} gates)",
+    ]
+    for name, passed in sorted(gates.items()):
+        lines.append(f"  [{'ok' if passed else 'FAIL'}] {name}")
+    lines.append(
+        f"  budget {soak.get('budget_bytes', 0)}B: high-water "
+        f"{soak.get('early_peak_bytes', 0)}B early → "
+        f"{soak.get('late_peak_bytes', 0)}B late "
+        f"(oracle grew to {soak.get('oracle_final_bytes', 0)}B)")
+    lines.append(
+        f"  evictions {soak.get('evictions', 0)} "
+        f"({soak.get('evicted_bytes', 0)}B out, "
+        f"{soak.get('refetch_bytes', 0)}B refetched), "
+        f"scrub findings {soak.get('scrub', {}).get('findings', 0)}, "
+        f"audit errors {soak.get('audit_errors', 0)}")
     return "\n".join(lines) + "\n"
 
 

@@ -182,6 +182,14 @@ STORAGE_SCRUB_CHUNKS = "makisu_storage_scrub_chunks_total"
 STORAGE_SCRUB_BYTES = "makisu_storage_scrub_bytes_total"
 STORAGE_SCRUB_CORRUPT = "makisu_storage_scrub_corrupt_total"
 
+# Storage mechanism plane (storage/contentstore.py): the budget
+# evictor's victims by reason (lru|quota|demote|demote_pack), per-tier
+# byte gauges (tier=hot|pack|remote), and bytes moved back by
+# pack-tier refetch promotions.
+STORAGE_EVICTIONS = "makisu_storage_evictions_total"
+STORAGE_TIER_BYTES = "makisu_storage_tier_bytes"
+STORAGE_REFETCH_BYTES = "makisu_storage_refetch_bytes_total"
+
 # Fleet SLO plane (fleet/slo.py + utils/alerts.py): alert lifecycle
 # counters (labeled rule/severity), the active-alert gauge a threshold
 # rule or dashboard reads directly, webhook delivery outcomes

@@ -1124,12 +1124,35 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                     kind = str(f.get("kind", "?"))
                     finding_kinds[kind] = \
                         finding_kinds.get(kind, 0) + 1
+        # Budget digest (storage/contentstore.py): what the scheduler's
+        # disk-pressure routing and fleet doctor read — budget, hot-tier
+        # occupancy, and their ratio ("pressure"; 0.0 when unbudgeted).
+        from makisu_tpu.storage import contentstore
+        budget_total = 0
+        hot_total = 0
+        for storage_dir in dirs:
+            try:
+                store = contentstore.store_for(storage_dir)
+                budget_total += store.budget_bytes
+                hot_total += store.tier_bytes(publish=False)["hot"]
+            except OSError:
+                continue
+        counters = contentstore.counters()
         return {
             "dirs": len(dirs),
             "planes": planes,
             "total_bytes": total_bytes,
             "total_objects": total_objects,
             "lru_seed": seed,
+            "budget": {
+                "budget_bytes": budget_total,
+                "hot_bytes": hot_total,
+                "pressure": (round(hot_total / budget_total, 4)
+                             if budget_total > 0 else 0.0),
+                "evictions_total": counters["evictions"],
+                "evicted_bytes": counters["evicted_bytes"],
+                "refetch_bytes": counters["refetch_bytes"],
+            },
             "findings": {
                 "total": sum(finding_kinds.values()),
                 "kinds": dict(sorted(finding_kinds.items())),
@@ -1157,6 +1180,12 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             if eviction_budget is not None:
                 entry["eviction_dry_run"] = engine.eviction_dry_run(
                     eviction_budget, seed_state=seed)
+            from makisu_tpu.storage import contentstore
+            try:
+                entry["contentstore"] = contentstore.store_for(
+                    storage_dir).describe()
+            except OSError:
+                pass
             with self._storage_mu:
                 state = self._storage_state.setdefault(
                     storage_dir, {})
@@ -1176,9 +1205,14 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
         puts them in crash bundles for free)."""
         from makisu_tpu.cache import census as census_mod
         from makisu_tpu.utils import logging as log
+        from makisu_tpu.storage import contentstore
         while not self._scrub_stop.wait(interval):
             for storage_dir in self.storage_dirs():
                 try:
+                    # Budget enforcement rides the same cadence as
+                    # integrity: a worker idle between builds still
+                    # converges to its byte budget (no-op unbudgeted).
+                    contentstore.store_for(storage_dir).maybe_evict()
                     engine = census_mod.StorageCensus(storage_dir)
                     doc = engine.census()
                     result = engine.scrub()
@@ -1415,6 +1449,13 @@ class WorkerServer(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
                 census_mod.record_attribution(
                     flags["storage"], record.tenant,
                     record.layer_hexes())
+            if flags["storage"]:
+                # Budget enforcement at the moment disk grows: build
+                # end is when new chunks/blobs landed. Throttled and
+                # a no-op when unbudgeted; never fails the build.
+                from makisu_tpu.storage import contentstore
+                contentstore.store_for(
+                    flags["storage"]).maybe_evict()
             fleet_peers.reset_self_socket(peers_token)
             session_mod.reset_manager(session_token)
             if fleet_token is not None:

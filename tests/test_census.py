@@ -9,6 +9,7 @@ layout for manifests — then measure, break, and re-measure it.
 import hashlib
 import json
 import os
+import shutil
 
 import pytest
 
@@ -191,6 +192,11 @@ def test_audit_clean_store_has_no_findings(tmp_path):
 def test_audit_names_dangling_chunk(tmp_path):
     storage, _, fps = _populate(tmp_path)
     os.unlink(os.path.join(storage, "chunks", fps[0][:2], fps[0]))
+    # A missing chunk whose pack survives as a compressed twin is
+    # DEMOTED (recoverable), not dangling — remove the twin so the
+    # loss is genuinely unrecoverable.
+    shutil.rmtree(os.path.join(storage, "serve", "zpacks"),
+                  ignore_errors=True)
     out = StorageCensus(storage).audit()
     kinds = {f["kind"] for f in out["findings"]}
     assert "dangling_chunk" in kinds
@@ -201,6 +207,20 @@ def test_audit_names_dangling_chunk(tmp_path):
     assert dangling["severity"] == "error"
     assert out["classification"]["recipes"]["dangling"] == 1
     assert out["classification"]["packs"]["dangling"] == 1
+
+
+@pytest.mark.skipif(not zstdio.available(), reason="no zstd")
+def test_audit_missing_chunk_with_twin_is_demoted(tmp_path):
+    """A chunk absent from the CAS whose pack has a seekable twin is
+    the budget evictor's expected footprint: classified demoted, zero
+    findings — a post-eviction `doctor --storage` must exit clean."""
+    storage, _, fps = _populate(tmp_path)
+    os.unlink(os.path.join(storage, "chunks", fps[0][:2], fps[0]))
+    out = StorageCensus(storage).audit()
+    assert out["findings"] == []
+    assert out["classification"]["chunks"]["demoted"] == 1
+    assert out["classification"]["recipes"]["dangling"] == 0
+    assert out["classification"]["packs"]["dangling"] == 0
 
 
 def test_audit_names_dangling_blob(tmp_path):
@@ -407,9 +427,12 @@ def test_worker_healthz_and_storage_endpoint(tmp_path):
         assert section["total_bytes"] > 0
         assert section["lru_seed"]["state"] == "seeded"
         assert section["findings"]["total"] == 0
-        # Break a reference; /storage re-walks fresh and names it.
+        # Break a reference (twin removed too — a recoverable miss
+        # is demoted, not a finding); /storage re-walks and names it.
         os.unlink(os.path.join(storage, "chunks",
                                fps[0][:2], fps[0]))
+        shutil.rmtree(os.path.join(storage, "serve", "zpacks"),
+                      ignore_errors=True)
         report = client.storage(eviction_budget=0)
         (entry,) = report["storage"]
         kinds = {f["kind"] for f in entry["audit"]["findings"]}
@@ -463,6 +486,8 @@ def test_cli_doctor_storage_exit_codes(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "no findings" in out
     os.unlink(os.path.join(storage, "chunks", fps[0][:2], fps[0]))
+    shutil.rmtree(os.path.join(storage, "serve", "zpacks"),
+                  ignore_errors=True)
     assert cli.main(["doctor", "--storage", storage]) == 1
     out = capsys.readouterr().out
     assert "dangling_chunk" in out
